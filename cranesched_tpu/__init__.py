@@ -1,0 +1,21 @@
+"""cranesched-tpu: a TPU-native cluster job scheduling framework.
+
+A from-scratch rebuild of the capability surface of PKUHPC/CraneSched
+(reference: /root/reference) designed TPU-first:
+
+- ``ops/``      JAX primitives for the scheduler's resource algebra
+                (fixed-point cpu, feasibility masks, fit counts).
+- ``models/``   Scheduler "models": jit-compiled solve() functions mapping
+                (cluster state, job batch) -> placements. The flagship model
+                is the per-cycle constraint solve that replaces the C++
+                NodeSelect loop (reference: src/CraneCtld/JobScheduler.cpp:6507).
+- ``parallel/`` Mesh/sharding layer: shard_map'd solvers that split the node
+                axis across devices with ICI collectives for the argmin merge.
+- ``ctld/``     Host control plane: job lifecycle, queues, accounting,
+                persistence (WAL), dispatch (reference: src/CraneCtld/).
+- ``craned/``   Node plane: simulated in-process craneds for tests plus the
+                interface the real C++ daemon implements.
+- ``utils/``    Hostlist grammar, config parsing, logging.
+"""
+
+__version__ = "0.1.0"

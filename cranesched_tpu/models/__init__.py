@@ -1,0 +1,15 @@
+from cranesched_tpu.models.solver import (
+    ClusterState,
+    JobBatch,
+    Placements,
+    solve_greedy,
+    make_cluster_state,
+)
+
+__all__ = [
+    "ClusterState",
+    "JobBatch",
+    "Placements",
+    "solve_greedy",
+    "make_cluster_state",
+]

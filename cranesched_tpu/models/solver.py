@@ -1,0 +1,204 @@
+"""The per-cycle scheduling solve as a jit-compiled JAX function.
+
+This is the TPU-native replacement for the reference's C++ NodeSelect loop
+(reference: src/CraneCtld/JobScheduler.cpp:6507-6836 and
+LocalScheduler::GetNodesAndTrySchedule_ at :6147-6369): for each pending job
+in priority order, find the ``node_num`` cheapest alive nodes (by the
+MinCpuTimeRatioFirst cost policy, JobScheduler.h:40-54) on which the job's
+per-node requirement fits *right now*, allocate, and update node costs.
+
+Design (TPU-first, not a translation):
+
+* Cluster state is a dense SoA: ``avail[N, R]`` int32 resource vectors,
+  ``total[N, R]``, boolean masks, and a float32 ``cost[N]`` vector.  The
+  reference's cost-ordered ``std::set`` + per-node object scan becomes a
+  masked top-k over the cost vector — one vectorized op instead of an
+  O(nodes) pointer walk.
+* The inherently sequential greedy loop (each placement mutates
+  availability) is a ``lax.scan`` over the priority-ordered job batch.  Each
+  scan step is O(N*R) vector work that XLA fuses; there is no data-dependent
+  control flow.  ``solve_batched`` (models/speculative.py) processes many
+  jobs per step with conflict repair and is the fast path; this scan is the
+  semantics-defining reference path the fast path must agree with.
+* Selection semantics match the reference: nodes are considered in ascending
+  cost order and the first ``node_num`` nodes whose *current* availability
+  fits the per-node requirement are taken (GetNodesAndTrySchedule_ iterates
+  GetOrderedNodesSet and breaks once node_num feasible nodes are found).
+  Ties in cost resolve to the lowest node index (the reference's tie order —
+  pointer value in a std::set — is unspecified; we pin it down).
+* A job that cannot be placed leaves state untouched and is reported
+  unplaced with a pending-reason code (resource vs partition/constraint),
+  mirroring the reason strings of NodeSelect.
+
+Not yet in this v0 model (tracked for later rounds, see SURVEY.md §7 build
+order): the time axis (backfill / earliest-start), preemption, reservations,
+multi-task-per-node packing (ntasks_per_node > 1), exclusive nodes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cranesched_tpu.ops.resources import DIM_CPU
+
+# Pending-reason codes (subset of the reference's pending reasons,
+# docs/en/reference/pending_reason.md).
+REASON_NONE = 0  # placed
+REASON_RESOURCE = 1  # feasible nodes exist but not enough free now
+REASON_CONSTRAINT = 2  # partition/include/exclude/alive masks rule nodes out
+REASON_PRIORITY = 3  # cut off by batch limit (set host-side)
+REASON_HELD = 4  # held / dependency / begin-time (set host-side)
+
+
+@struct.dataclass
+class ClusterState:
+    """Device-resident cluster snapshot for one scheduling cycle.
+
+    avail:  int32[N, R]  free resources per node (resource-vector encoding)
+    total:  int32[N, R]  total resources per node
+    alive:  bool[N]      node is up and not drained
+    cost:   f32[N]       MinCpuTimeRatioFirst running cost per node
+                         (sum over allocations of duration * cpu/cpu_total;
+                         reference JobScheduler.h:40-54, NodeRater h:499-516)
+    """
+
+    avail: jax.Array
+    total: jax.Array
+    alive: jax.Array
+    cost: jax.Array
+
+    @property
+    def num_nodes(self) -> int:
+        return self.avail.shape[0]
+
+    @property
+    def num_dims(self) -> int:
+        return self.avail.shape[1]
+
+
+@struct.dataclass
+class JobBatch:
+    """Priority-ordered pending jobs for one cycle (SoA, padded to J).
+
+    req:        int32[J, R] per-node resource requirement
+                (node_res + task_res * ntasks_per_node; reference
+                ``min_res_view`` at JobScheduler.cpp:6153)
+    node_num:   int32[J]    gang size (nodes required simultaneously)
+    time_limit: int32[J]    seconds; drives the cost update
+    part_mask:  bool[J, N]  per-job node eligibility (partition membership
+                            AND include/exclude nodelists, precomputed
+                            host-side as bitmasks)
+    valid:      bool[J]     padding mask (False rows are no-ops)
+    """
+
+    req: jax.Array
+    node_num: jax.Array
+    time_limit: jax.Array
+    part_mask: jax.Array
+    valid: jax.Array
+
+    @property
+    def num_jobs(self) -> int:
+        return self.req.shape[0]
+
+
+@struct.dataclass
+class Placements:
+    """Solve output, aligned with the input job order.
+
+    placed: bool[J]
+    nodes:  int32[J, K] chosen node indices, -1 padded (K = max gang size)
+    reason: int32[J]    REASON_* for unplaced jobs
+    """
+
+    placed: jax.Array
+    nodes: jax.Array
+    reason: jax.Array
+
+
+def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
+    avail = jnp.asarray(avail, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    alive = jnp.asarray(alive, bool)
+    if cost is None:
+        cost = jnp.zeros(avail.shape[0], jnp.float32)
+    return ClusterState(avail=avail, total=total, alive=alive,
+                        cost=jnp.asarray(cost, jnp.float32))
+
+
+def _place_one(avail, cost, state_total, state_alive, req, node_num,
+               time_limit, part_mask, valid, max_nodes: int):
+    """Try to place one job; returns updated (avail, cost) and the decision."""
+    n = avail.shape[0]
+
+    eligible = state_alive & part_mask
+    fits_now = jnp.all(req[None, :] <= avail, axis=-1)
+    feasible = eligible & fits_now
+
+    num_feasible = jnp.sum(feasible, dtype=jnp.int32)
+    # node_num > max_nodes violates the static bound; refuse rather than
+    # silently allocating a partial gang.
+    ok = (valid & (node_num > 0) & (node_num <= max_nodes)
+          & (num_feasible >= node_num))
+
+    # "First node_num feasible nodes in ascending cost order": mask
+    # infeasible nodes to +inf and take the k smallest.  jnp.argsort is
+    # ascending and stable, so ties go to the lowest node index.
+    masked_cost = jnp.where(feasible, cost, jnp.inf)
+    # top_k on negated cost returns the k smallest costs; stable w.r.t. index.
+    neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+    k_mask = jnp.arange(max_nodes) < node_num
+    sel = ok & k_mask & jnp.isfinite(neg_cost)
+
+    # Scatter-subtract the requirement from the chosen rows.
+    delta = jnp.where(sel[:, None], req[None, :], 0)
+    avail = avail.at[idx].add(-delta, mode="drop")
+
+    # MinCpuTimeRatioFirst cost update: += seconds * cpu_alloc / cpu_total.
+    cpu_total = jnp.maximum(state_total[:, DIM_CPU], 1).astype(jnp.float32)
+    dcost = (time_limit.astype(jnp.float32)
+             * req[DIM_CPU].astype(jnp.float32) / cpu_total[idx])
+    cost = cost.at[idx].add(jnp.where(sel, dcost, 0.0), mode="drop")
+
+    chosen = jnp.where(sel, idx, -1)
+    # Reason: constraint for invalid/empty jobs or when eligibility alone
+    # rules the job out; resource when eligible nodes exist but are busy.
+    bad = (~valid) | (node_num <= 0)
+    any_could_ever = jnp.sum(eligible, dtype=jnp.int32) >= node_num
+    reason = jnp.where(
+        ok, REASON_NONE,
+        jnp.where(bad | ~any_could_ever, REASON_CONSTRAINT, REASON_RESOURCE))
+    return avail, cost, ok, chosen, reason
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def solve_greedy(state: ClusterState, jobs: JobBatch,
+                 max_nodes: int = 1) -> tuple[Placements, ClusterState]:
+    """Greedy in-priority-order placement via lax.scan (reference path).
+
+    jobs must already be in descending priority order (see models/priority.py
+    for the multifactor sort).  ``max_nodes`` is the static bound on gang
+    size for this batch; jobs with node_num > max_nodes are refused with
+    REASON_CONSTRAINT.
+    """
+    max_nodes = min(max_nodes, state.num_nodes)
+
+    def step(carry, job):
+        avail, cost = carry
+        req, node_num, time_limit, part_mask, valid = job
+        avail, cost, ok, chosen, reason = _place_one(
+            avail, cost, state.total, state.alive, req, node_num,
+            time_limit, part_mask, valid, max_nodes)
+        return (avail, cost), (ok, chosen, reason)
+
+    (avail, cost), (placed, nodes, reason) = jax.lax.scan(
+        step, (state.avail, state.cost),
+        (jobs.req, jobs.node_num, jobs.time_limit, jobs.part_mask,
+         jobs.valid))
+
+    new_state = state.replace(avail=avail, cost=cost)
+    return Placements(placed=placed, nodes=nodes, reason=reason), new_state

@@ -1,0 +1,23 @@
+from cranesched_tpu.ops.resources import (
+    CPU_SCALE,
+    MEM_UNIT_BYTES,
+    DIM_CPU,
+    DIM_MEM,
+    DIM_MEMSW,
+    NUM_BASE_DIMS,
+    ResourceLayout,
+    fits,
+    fit_count,
+)
+
+__all__ = [
+    "CPU_SCALE",
+    "MEM_UNIT_BYTES",
+    "DIM_CPU",
+    "DIM_MEM",
+    "DIM_MEMSW",
+    "NUM_BASE_DIMS",
+    "ResourceLayout",
+    "fits",
+    "fit_count",
+]

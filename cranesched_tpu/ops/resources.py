@@ -1,0 +1,150 @@
+"""Resource algebra as dense integer tensors.
+
+The reference models resources as an object graph (reference:
+src/Utilities/PublicHeader/include/crane/PublicHeader.h:555-778 —
+``CpuSet``/``ResourceInNodeV3``/``ResourceView`` with fixed-point
+``cpu_t = fpm::fixed<int64,int128,8>``).  On TPU the same algebra is a flat
+int32 vector per (node|job) with one dimension per resource kind, so that
+
+* feasibility       = elementwise ``req <= avail`` reduced over the last axis
+  (reference ``operator<=``, PublicHeader.h:760-765),
+* allocation/free   = vector add/sub,
+* max-fit count     = ``min_over_dims(avail // req)`` (reference ``operator/``
+  semantics: "minimum quotient across all resource dimensions",
+  PublicHeader.h:769-772),
+
+all of which vectorize over (jobs x nodes) without data-dependent shapes.
+
+Encoding
+--------
+dim 0: cpu, fixed point with 8 fractional bits (CPU_SCALE = 256 units per
+       core) — matches the reference's fpm scale so host ledgers and device
+       tensors agree bit-for-bit on fractional cpus.
+dim 1: memory, MiB.
+dim 2: memory+swap, MiB.
+dim 3+: one dimension per configured GRES (name, type) pair, unit = slots.
+
+int32 bounds: 2**31/256 = 8.3M cores, 2**31 MiB = 2 PiB memory per node —
+far beyond any single node, and per-cluster totals are never stored as a
+single vector on device.
+
+Slot identity (which core ids / which device slots — reference
+``CpuSet.core_ids`` and ``DedicatedResourceInNode.name_type_slots_map``) is
+deliberately NOT on device: the solve only needs quantities
+(reference ``ResourceView``, "Flat structure for scheduling phase"); concrete
+slot ids are chosen host-side at dispatch time (see ctld/dispatch), mirroring
+how the reference picks slots in ``GetFeasibleResourceInNode``
+(PublicHeader.cpp:519-600) only after scheduling decided quantities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+# Fixed-point scale for cpu counts: 8 fractional bits, matching the
+# reference's cpu_t (PublicHeader.h:44).
+CPU_SCALE = 256
+# Memory unit for device tensors.
+MEM_UNIT_BYTES = 1 << 20  # 1 MiB
+
+DIM_CPU = 0
+DIM_MEM = 1
+DIM_MEMSW = 2
+NUM_BASE_DIMS = 3
+
+# A value safely above any real per-dimension quantity, used for "infinite"
+# availability in masked comparisons. Kept well under int32 max so sums of a
+# few of these cannot overflow.
+BIG = np.int32(2**30)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceLayout:
+    """Static (compile-time) mapping of resource dimensions.
+
+    ``gres_dims`` maps a ``(name, type)`` GRES pair — e.g. ``("gpu", "a100")``
+    — to its tensor dimension index (>= NUM_BASE_DIMS). The layout is part of
+    the compiled solver's static configuration; changing the GRES inventory
+    recompiles, which matches how the reference treats device config as
+    cluster topology (etc/config.yaml:139-160).
+    """
+
+    gres_dims: Mapping[tuple[str, str], int] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def __post_init__(self):
+        # freeze dict for hashing
+        object.__setattr__(self, "gres_dims", dict(self.gres_dims))
+
+    @property
+    def num_dims(self) -> int:
+        return NUM_BASE_DIMS + len(self.gres_dims)
+
+    @staticmethod
+    def from_gres_names(pairs: Sequence[tuple[str, str]]) -> "ResourceLayout":
+        return ResourceLayout(
+            {p: NUM_BASE_DIMS + i for i, p in enumerate(pairs)}
+        )
+
+    # ---- host-side encoding helpers (NumPy, used by ctld and tests) ----
+
+    def encode(
+        self,
+        cpu: float = 0.0,
+        mem_bytes: int = 0,
+        memsw_bytes: int = 0,
+        gres: Mapping[tuple[str, str], int] | None = None,
+    ) -> np.ndarray:
+        """Encode one resource quantity as an int32 vector.
+
+        cpu is rounded to the nearest 1/256 core (the reference constructs
+        cpu_t from doubles the same way).  mem is rounded UP to MiB on
+        requests' behalf being conservative is the caller's choice; here we
+        round up so that a request never silently fits where bytes wouldn't.
+        """
+        v = np.zeros(self.num_dims, dtype=np.int32)
+        v[DIM_CPU] = int(round(cpu * CPU_SCALE))
+        v[DIM_MEM] = -(-int(mem_bytes) // MEM_UNIT_BYTES)
+        v[DIM_MEMSW] = -(-int(memsw_bytes) // MEM_UNIT_BYTES)
+        for key, count in (gres or {}).items():
+            v[self.gres_dims[key]] = int(count)
+        return v
+
+    def decode_cpu(self, v: np.ndarray) -> float:
+        return float(v[DIM_CPU]) / CPU_SCALE
+
+    def decode_mem_bytes(self, v: np.ndarray) -> int:
+        return int(v[DIM_MEM]) * MEM_UNIT_BYTES
+
+
+def fits(req, avail):
+    """``req <= avail`` over the resource axis.
+
+    req:   [..., R]
+    avail: [..., R] (broadcastable)
+    -> bool[...]
+
+    Mirrors reference ``operator<=(ResourceView, ResourceInNodeV3)``
+    (PublicHeader.cpp): every dimension must fit.
+    """
+    return jnp.all(req <= avail, axis=-1)
+
+
+def fit_count(avail, req):
+    """How many tasks of ``req`` fit into ``avail`` (elementwise min quotient).
+
+    avail: [..., R], req: [..., R] -> int32[...]
+
+    Mirrors reference ``operator/(ResourceView, ResourceView)``
+    (PublicHeader.h:769-772): minimum of avail_d / req_d over dimensions with
+    req_d > 0; dimensions the job doesn't request don't constrain it.
+    """
+    avail = jnp.asarray(avail)
+    req = jnp.asarray(req)
+    q = jnp.where(req > 0, avail // jnp.maximum(req, 1), BIG)
+    return jnp.min(q, axis=-1)

@@ -1,0 +1,77 @@
+"""NumPy oracle for the placement solve — the parity reference.
+
+Independent transcription of the scheduling semantics (reference:
+src/CraneCtld/JobScheduler.cpp GetNodesAndTrySchedule_ :6147-6369, cost
+policy MinCpuTimeRatioFirst JobScheduler.h:40-54), written in plain Python
+loops so it is obviously-correct and diffable against the TPU solver.
+
+The reference's only unspecified behavior — cost-tie ordering inside the
+std::set<pair<double, NodeState*>> — is pinned to "lowest node index first",
+and the TPU solver pins the same.
+
+Uses float32 cost accumulation to match the device solver exactly (the
+reference uses double; cost magnitude ordering is what matters for parity,
+and both of OUR implementations must agree bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cranesched_tpu.models.solver import (
+    REASON_CONSTRAINT,
+    REASON_NONE,
+    REASON_RESOURCE,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+
+def solve_greedy_oracle(avail, total, alive, cost, req, node_num,
+                        time_limit, part_mask, valid, max_nodes):
+    """Same contract as models.solver.solve_greedy, in NumPy.
+
+    Returns (placed[J], nodes[J, max_nodes], reason[J], avail', cost').
+    """
+    avail = np.array(avail, dtype=np.int64)  # headroom; values fit int32
+    cost = np.array(cost, dtype=np.float32)
+    total = np.asarray(total)
+    alive = np.asarray(alive, bool)
+
+    J = len(req)
+    N = avail.shape[0]
+    placed = np.zeros(J, bool)
+    nodes_out = np.full((J, max_nodes), -1, np.int32)
+    reason = np.zeros(J, np.int32)
+
+    for j in range(J):
+        if not valid[j] or node_num[j] <= 0:
+            reason[j] = REASON_CONSTRAINT
+            continue
+        eligible = alive & part_mask[j]
+        if node_num[j] > min(max_nodes, N):
+            # exceeds the batch's static gang bound — refused, same reason
+            # logic as the solver
+            reason[j] = (REASON_RESOURCE if eligible.sum() >= node_num[j]
+                         else REASON_CONSTRAINT)
+            continue
+        feasible = eligible & np.all(req[j][None, :] <= avail, axis=-1)
+        if feasible.sum() < node_num[j]:
+            reason[j] = (REASON_RESOURCE if eligible.sum() >= node_num[j]
+                         else REASON_CONSTRAINT)
+            continue
+        # ascending cost, ties -> lowest index (stable sort over index order)
+        order = np.argsort(np.where(feasible, cost, np.inf), kind="stable")
+        chosen = order[: node_num[j]]
+        for n in chosen:
+            avail[n] -= req[j]
+            cpu_total = max(int(total[n, DIM_CPU]), 1)
+            cost[n] = np.float32(
+                cost[n]
+                + np.float32(time_limit[j])
+                * np.float32(req[j, DIM_CPU]) / np.float32(cpu_total))
+        placed[j] = True
+        # cost order (ties -> lowest index), matching the solver's top_k
+        nodes_out[j, : node_num[j]] = chosen
+        reason[j] = REASON_NONE
+
+    return placed, nodes_out, reason, avail.astype(np.int32), cost

@@ -1,0 +1,60 @@
+"""Resource algebra tests (reference semantics:
+src/Utilities/PublicHeader — fixed-point cpu, min-quotient division,
+elementwise <=; reference tests test/Utilities/dedicated_resource_test.cpp)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from cranesched_tpu.ops import resources as R
+
+
+def test_layout_dims():
+    lay = R.ResourceLayout.from_gres_names([("gpu", "a100"), ("gpu", "h100")])
+    assert lay.num_dims == 5
+    assert lay.gres_dims[("gpu", "a100")] == 3
+
+
+def test_encode_fixed_point_cpu():
+    lay = R.ResourceLayout()
+    v = lay.encode(cpu=0.5)
+    assert v[R.DIM_CPU] == 128  # 0.5 * 256
+    assert lay.decode_cpu(v) == 0.5
+    # 1/256 granularity survives the round trip
+    v = lay.encode(cpu=3 + 1 / 256)
+    assert v[R.DIM_CPU] == 3 * 256 + 1
+
+
+def test_encode_mem_rounds_up():
+    lay = R.ResourceLayout()
+    v = lay.encode(mem_bytes=R.MEM_UNIT_BYTES + 1)
+    assert v[R.DIM_MEM] == 2
+
+
+def test_fits_elementwise():
+    lay = R.ResourceLayout.from_gres_names([("gpu", "a100")])
+    avail = lay.encode(cpu=4, mem_bytes=8 << 30, gres={("gpu", "a100"): 2})
+    req_ok = lay.encode(cpu=4, mem_bytes=8 << 30, gres={("gpu", "a100"): 2})
+    req_cpu = lay.encode(cpu=4.5)
+    req_gres = lay.encode(gres={("gpu", "a100"): 3})
+    assert bool(R.fits(jnp.asarray(req_ok), jnp.asarray(avail)))
+    assert not bool(R.fits(jnp.asarray(req_cpu), jnp.asarray(avail)))
+    assert not bool(R.fits(jnp.asarray(req_gres), jnp.asarray(avail)))
+
+
+def test_fit_count_min_quotient():
+    lay = R.ResourceLayout.from_gres_names([("gpu", "a100")])
+    avail = lay.encode(cpu=16, mem_bytes=64 << 30, gres={("gpu", "a100"): 8})
+    req = lay.encode(cpu=2, mem_bytes=4 << 30, gres={("gpu", "a100"): 3})
+    # cpu: 8 fit; mem: 16 fit; gpu: 2 fit -> min = 2
+    assert int(R.fit_count(jnp.asarray(avail), jnp.asarray(req))) == 2
+    # dimensions not requested don't constrain
+    req2 = lay.encode(cpu=3)
+    assert int(R.fit_count(jnp.asarray(avail), jnp.asarray(req2))) == 5
+
+
+def test_fit_count_batched():
+    lay = R.ResourceLayout()
+    avail = np.stack([lay.encode(cpu=c) for c in (1, 2, 4)])
+    req = lay.encode(cpu=2)
+    out = np.asarray(R.fit_count(jnp.asarray(avail), jnp.asarray(req)))
+    assert list(out) == [0, 1, 2]
