@@ -1,0 +1,105 @@
+"""Benchmark: scheduling decisions/sec of the placement solve on real TPU.
+
+Shapes mirror BASELINE.json's north-star workload (100k pending jobs x 10k
+nodes).  The baseline number is the reference's published ">100,000
+scheduling decisions per second" (reference README_EN.md:29; see
+BASELINE.md) — ``vs_baseline`` is measured decisions/sec divided by that.
+
+Prints exactly ONE JSON line on stdout.
+
+Env overrides: BENCH_JOBS, BENCH_NODES, BENCH_REPEATS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_DECISIONS_PER_SEC = 100_000.0
+
+
+def main() -> int:
+    num_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
+    num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+
+    import jax
+    import jax.numpy as jnp
+
+    from cranesched_tpu.models.solver import (
+        JobBatch,
+        make_cluster_state,
+        solve_greedy,
+    )
+    from cranesched_tpu.ops.resources import ResourceLayout
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    lay = ResourceLayout()
+
+    total = np.stack([
+        lay.encode(cpu=int(rng.integers(32, 129)),
+                   mem_bytes=int(rng.integers(64, 513)) << 30,
+                   is_capacity=True)
+        for _ in range(num_nodes)
+    ])
+    state = make_cluster_state(total.copy(), total,
+                               rng.random(num_nodes) > 0.02,
+                               rng.random(num_nodes).astype(np.float32))
+
+    req = np.stack([
+        lay.encode(cpu=float(rng.integers(1, 17)),
+                   mem_bytes=int(rng.integers(1, 33)) << 30)
+        for _ in range(num_jobs)
+    ])
+    # Partition eligibility computed on device (a [J, N] host transfer at
+    # this scale would dominate; real cycles also build it device-side).
+    node_part = jnp.asarray(rng.integers(0, 4, num_nodes), jnp.int32)
+    job_part = jnp.asarray(rng.integers(0, 4, num_jobs), jnp.int32)
+    part_mask = job_part[:, None] == node_part[None, :]
+
+    jobs = JobBatch(
+        req=jnp.asarray(req),
+        node_num=jnp.asarray(rng.integers(1, 3, num_jobs), jnp.int32),
+        time_limit=jnp.asarray(rng.integers(60, 86400, num_jobs), jnp.int32),
+        part_mask=part_mask,
+        valid=jnp.ones(num_jobs, bool))
+
+    state = jax.device_put(state, dev)
+    jobs = jax.device_put(jobs, dev)
+
+    # warmup / compile
+    placements, _ = solve_greedy(state, jobs, max_nodes=2)
+    placements.placed.block_until_ready()
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        placements, _ = solve_greedy(state, jobs, max_nodes=2)
+        placements.placed.block_until_ready()
+        times.append(time.perf_counter() - t0)
+
+    cycle_s = float(np.median(times))
+    decisions_per_sec = num_jobs / cycle_s
+    print(json.dumps({
+        "metric": "decisions_per_sec",
+        "value": round(decisions_per_sec, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(decisions_per_sec / BASELINE_DECISIONS_PER_SEC,
+                             3),
+        "detail": {
+            "jobs": num_jobs, "nodes": num_nodes,
+            "cycle_seconds_median": round(cycle_s, 4),
+            "placed": int(np.asarray(placements.placed).sum()),
+            "device": str(dev), "repeats": repeats,
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

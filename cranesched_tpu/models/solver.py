@@ -130,48 +130,74 @@ def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
                         cost=jnp.asarray(cost, jnp.float32))
 
 
+def job_feasibility(avail, alive, part_mask, req):
+    """eligible/feasible node masks for one job against one (shard of the)
+    cluster — the per-job predicate both solver paths share."""
+    eligible = alive & part_mask
+    fits_now = jnp.all(req[None, :] <= avail, axis=-1)
+    return eligible, eligible & fits_now
+
+
+def decide_job(valid, node_num, max_nodes, num_feasible, num_eligible):
+    """Admission decision + pending reason from the (global) counts.
+
+    node_num > max_nodes violates the static gang bound; refuse rather than
+    silently allocating a partial gang.  Reason: constraint for invalid
+    jobs or when eligibility alone rules the job out; resource when enough
+    eligible nodes exist but are busy (mirrors the reason strings of
+    NodeSelect).
+    """
+    ok = (valid & (node_num > 0) & (node_num <= max_nodes)
+          & (num_feasible >= node_num))
+    bad = (~valid) | (node_num <= 0)
+    any_could_ever = num_eligible >= node_num
+    reason = jnp.where(
+        ok, REASON_NONE,
+        jnp.where(bad | ~any_could_ever, REASON_CONSTRAINT, REASON_RESOURCE))
+    return ok, reason
+
+
+def apply_placement(avail, cost, total, req, time_limit, scatter_idx,
+                    apply_mask):
+    """Subtract ``req`` from rows ``scatter_idx`` where ``apply_mask`` and
+    apply the MinCpuTimeRatioFirst cost update
+    (cost += seconds * cpu_alloc / cpu_total; reference JobScheduler.h:40-54).
+
+    Rows with apply_mask False must carry an out-of-range ``scatter_idx``
+    OR a zero delta; both paths pass mode="drop"-safe indices.
+    """
+    local_n = avail.shape[0]
+    delta = jnp.where(apply_mask[:, None], req[None, :], 0)
+    avail = avail.at[scatter_idx].add(-delta, mode="drop")
+
+    cpu_total = jnp.maximum(total[:, DIM_CPU], 1).astype(jnp.float32)
+    safe = jnp.clip(scatter_idx, 0, local_n - 1)
+    dcost = (time_limit.astype(jnp.float32)
+             * req[DIM_CPU].astype(jnp.float32) / cpu_total[safe])
+    cost = cost.at[scatter_idx].add(
+        jnp.where(apply_mask, dcost, 0.0), mode="drop")
+    return avail, cost
+
+
 def _place_one(avail, cost, state_total, state_alive, req, node_num,
                time_limit, part_mask, valid, max_nodes: int):
     """Try to place one job; returns updated (avail, cost) and the decision."""
-    n = avail.shape[0]
-
-    eligible = state_alive & part_mask
-    fits_now = jnp.all(req[None, :] <= avail, axis=-1)
-    feasible = eligible & fits_now
-
-    num_feasible = jnp.sum(feasible, dtype=jnp.int32)
-    # node_num > max_nodes violates the static bound; refuse rather than
-    # silently allocating a partial gang.
-    ok = (valid & (node_num > 0) & (node_num <= max_nodes)
-          & (num_feasible >= node_num))
+    eligible, feasible = job_feasibility(avail, state_alive, part_mask, req)
+    ok, reason = decide_job(valid, node_num, max_nodes,
+                            jnp.sum(feasible, dtype=jnp.int32),
+                            jnp.sum(eligible, dtype=jnp.int32))
 
     # "First node_num feasible nodes in ascending cost order": mask
-    # infeasible nodes to +inf and take the k smallest.  jnp.argsort is
-    # ascending and stable, so ties go to the lowest node index.
+    # infeasible nodes to +inf and take the k smallest.  top_k on negated
+    # cost returns the k smallest; ties go to the lowest node index.
     masked_cost = jnp.where(feasible, cost, jnp.inf)
-    # top_k on negated cost returns the k smallest costs; stable w.r.t. index.
     neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
     k_mask = jnp.arange(max_nodes) < node_num
     sel = ok & k_mask & jnp.isfinite(neg_cost)
 
-    # Scatter-subtract the requirement from the chosen rows.
-    delta = jnp.where(sel[:, None], req[None, :], 0)
-    avail = avail.at[idx].add(-delta, mode="drop")
-
-    # MinCpuTimeRatioFirst cost update: += seconds * cpu_alloc / cpu_total.
-    cpu_total = jnp.maximum(state_total[:, DIM_CPU], 1).astype(jnp.float32)
-    dcost = (time_limit.astype(jnp.float32)
-             * req[DIM_CPU].astype(jnp.float32) / cpu_total[idx])
-    cost = cost.at[idx].add(jnp.where(sel, dcost, 0.0), mode="drop")
-
+    avail, cost = apply_placement(avail, cost, state_total, req, time_limit,
+                                  idx, sel)
     chosen = jnp.where(sel, idx, -1)
-    # Reason: constraint for invalid/empty jobs or when eligibility alone
-    # rules the job out; resource when eligible nodes exist but are busy.
-    bad = (~valid) | (node_num <= 0)
-    any_could_ever = jnp.sum(eligible, dtype=jnp.int32) >= node_num
-    reason = jnp.where(
-        ok, REASON_NONE,
-        jnp.where(bad | ~any_could_ever, REASON_CONSTRAINT, REASON_RESOURCE))
     return avail, cost, ok, chosen, reason
 
 
@@ -182,8 +208,10 @@ def solve_greedy(state: ClusterState, jobs: JobBatch,
 
     jobs must already be in descending priority order (see models/priority.py
     for the multifactor sort).  ``max_nodes`` is the static bound on gang
-    size for this batch; jobs with node_num > max_nodes are refused with
-    REASON_CONSTRAINT.
+    size for this batch; jobs with node_num > max_nodes are refused — with
+    REASON_RESOURCE when enough eligible nodes exist (the gang merely exceeds
+    this batch's static bound) and REASON_CONSTRAINT when eligibility alone
+    rules the job out.
     """
     max_nodes = min(max_nodes, state.num_nodes)
 

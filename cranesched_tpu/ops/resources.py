@@ -66,30 +66,31 @@ BIG = np.int32(2**30)
 class ResourceLayout:
     """Static (compile-time) mapping of resource dimensions.
 
-    ``gres_dims`` maps a ``(name, type)`` GRES pair — e.g. ``("gpu", "a100")``
-    — to its tensor dimension index (>= NUM_BASE_DIMS). The layout is part of
-    the compiled solver's static configuration; changing the GRES inventory
-    recompiles, which matches how the reference treats device config as
-    cluster topology (etc/config.yaml:139-160).
+    ``gres_pairs`` is the ordered tuple of GRES ``(name, type)`` pairs — e.g.
+    ``("gpu", "a100")`` — whose tensor dimension index is
+    ``NUM_BASE_DIMS + position``. Stored as a tuple so the layout is hashable
+    and usable as a jit static argument; ``gres_dims`` exposes the dict view
+    for lookups. Changing the GRES inventory recompiles, which matches how the
+    reference treats device config as cluster topology
+    (etc/config.yaml:139-160).
     """
 
-    gres_dims: Mapping[tuple[str, str], int] = dataclasses.field(
-        default_factory=dict
-    )
+    gres_pairs: tuple[tuple[str, str], ...] = ()
 
     def __post_init__(self):
-        # freeze dict for hashing
-        object.__setattr__(self, "gres_dims", dict(self.gres_dims))
+        object.__setattr__(self, "gres_pairs", tuple(self.gres_pairs))
+
+    @property
+    def gres_dims(self) -> dict[tuple[str, str], int]:
+        return {p: NUM_BASE_DIMS + i for i, p in enumerate(self.gres_pairs)}
 
     @property
     def num_dims(self) -> int:
-        return NUM_BASE_DIMS + len(self.gres_dims)
+        return NUM_BASE_DIMS + len(self.gres_pairs)
 
     @staticmethod
     def from_gres_names(pairs: Sequence[tuple[str, str]]) -> "ResourceLayout":
-        return ResourceLayout(
-            {p: NUM_BASE_DIMS + i for i, p in enumerate(pairs)}
-        )
+        return ResourceLayout(tuple(pairs))
 
     # ---- host-side encoding helpers (NumPy, used by ctld and tests) ----
 
@@ -99,20 +100,28 @@ class ResourceLayout:
         mem_bytes: int = 0,
         memsw_bytes: int = 0,
         gres: Mapping[tuple[str, str], int] | None = None,
+        is_capacity: bool = False,
     ) -> np.ndarray:
         """Encode one resource quantity as an int32 vector.
 
         cpu is rounded to the nearest 1/256 core (the reference constructs
-        cpu_t from doubles the same way).  mem is rounded UP to MiB on
-        requests' behalf being conservative is the caller's choice; here we
-        round up so that a request never silently fits where bytes wouldn't.
+        cpu_t from doubles the same way).  Memory rounding is direction-aware
+        so quantization never admits a job raw bytes would refuse: requests
+        round UP to MiB (a request never silently shrinks), while capacities
+        (``is_capacity=True`` — node totals/availability) round DOWN (a node
+        never advertises more than it has).
         """
         v = np.zeros(self.num_dims, dtype=np.int32)
         v[DIM_CPU] = int(round(cpu * CPU_SCALE))
-        v[DIM_MEM] = -(-int(mem_bytes) // MEM_UNIT_BYTES)
-        v[DIM_MEMSW] = -(-int(memsw_bytes) // MEM_UNIT_BYTES)
+        if is_capacity:
+            v[DIM_MEM] = int(mem_bytes) // MEM_UNIT_BYTES
+            v[DIM_MEMSW] = int(memsw_bytes) // MEM_UNIT_BYTES
+        else:
+            v[DIM_MEM] = -(-int(mem_bytes) // MEM_UNIT_BYTES)
+            v[DIM_MEMSW] = -(-int(memsw_bytes) // MEM_UNIT_BYTES)
+        gres_dims = self.gres_dims
         for key, count in (gres or {}).items():
-            v[self.gres_dims[key]] = int(count)
+            v[gres_dims[key]] = int(count)
         return v
 
     def decode_cpu(self, v: np.ndarray) -> float:

@@ -1,0 +1,19 @@
+"""Mesh/sharding layer: multi-device scheduling solves.
+
+The node axis of the cluster tensors is sharded over the device mesh with
+``shard_map``; cross-shard decisions (which k nodes are globally cheapest)
+travel over ICI as ``all_gather``/``psum`` collectives.  See
+``parallel.sharded`` for the design notes.
+"""
+
+from cranesched_tpu.parallel.sharded import (
+    make_node_mesh,
+    shard_cluster_state,
+    solve_greedy_sharded,
+)
+
+__all__ = [
+    "make_node_mesh",
+    "shard_cluster_state",
+    "solve_greedy_sharded",
+]

@@ -1,0 +1,164 @@
+"""Node-axis-sharded greedy solve: the multi-chip scheduling path.
+
+The reference scales by throwing one big C++ process at the problem (the
+cost-ordered node set walk in LocalScheduler::GetNodesAndTrySchedule_,
+src/CraneCtld/JobScheduler.cpp:6147-6369, is strictly single-threaded per
+scheduling domain).  The TPU-native design instead shards the *node axis*
+of every cluster tensor across the device mesh (SURVEY.md §7), so a
+100k-node cluster's state lives in D chips' HBM and each placement step is:
+
+1. each shard computes feasibility + masked cost for its own nodes
+   (pure local vector work, no communication);
+2. each shard proposes its k cheapest feasible nodes (``lax.top_k``);
+3. one ``all_gather`` over ICI merges the D*k candidates; every shard
+   deterministically selects the same global k winners (ascending cost,
+   ties to the lowest global node index — candidates arrive shard-major
+   and within-shard ascending, so a stable argsort preserves that order);
+4. each shard applies the resource subtraction for the winners it owns
+   (scatter with OOB-drop — no communication).
+
+Feasible/eligible *counts* (for the "can this gang ever fit" decision and
+the pending-reason) are global ``psum`` reductions.
+
+This mirrors how the per-cycle solve distributes: jobs stay replicated
+(the greedy order is inherently sequential), nodes are the long axis.
+The collectives per job are O(D * max_nodes) bytes — tiny — so the ICI
+cost is latency-bound and amortized by XLA pipelining across scan steps.
+
+Parity contract: bit-identical placements to ``models.solver.solve_greedy``
+(asserted in tests/test_sharded_parity.py on an 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cranesched_tpu.models.solver import (
+    ClusterState,
+    JobBatch,
+    Placements,
+    apply_placement,
+    decide_job,
+    job_feasibility,
+)
+
+NODE_AXIS = "nodes"
+
+
+def make_node_mesh(devices=None) -> Mesh:
+    """1-D device mesh over which the node axis is sharded."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def shard_cluster_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+    """Place the cluster tensors with the node axis sharded over the mesh."""
+    row = NamedSharding(mesh, P(NODE_AXIS))
+    mat = NamedSharding(mesh, P(NODE_AXIS, None))
+    return ClusterState(
+        avail=jax.device_put(state.avail, mat),
+        total=jax.device_put(state.total, mat),
+        alive=jax.device_put(state.alive, row),
+        cost=jax.device_put(state.cost, row),
+    )
+
+
+def _place_one_shard(avail, cost, total, alive, req, node_num, time_limit,
+                     part_mask, valid, max_nodes: int):
+    """One placement step on one node shard (runs under shard_map).
+
+    The per-job math (feasibility, admission decision, resource/cost
+    update) is shared with the single-device solver — only the counts
+    (psum) and the candidate merge (all_gather) are collective here.
+    """
+    local_n = avail.shape[0]
+    shard = jax.lax.axis_index(NODE_AXIS)
+    offset = shard * local_n
+
+    eligible, feasible = job_feasibility(avail, alive, part_mask, req)
+    num_feasible = jax.lax.psum(
+        jnp.sum(feasible, dtype=jnp.int32), NODE_AXIS)
+    num_eligible = jax.lax.psum(
+        jnp.sum(eligible, dtype=jnp.int32), NODE_AXIS)
+    ok, reason = decide_job(valid, node_num, max_nodes, num_feasible,
+                            num_eligible)
+
+    # Local k cheapest feasible nodes.  top_k ties resolve to the lowest
+    # local index, matching the single-device solver's tie order.
+    k = min(max_nodes, local_n)
+    masked_cost = jnp.where(feasible, cost, jnp.inf)
+    neg_cost, lidx = jax.lax.top_k(-masked_cost, k)
+    cand_cost = -neg_cost
+    cand_gidx = lidx + offset
+
+    # Merge candidates across shards (ICI all_gather), then select the
+    # global k winners.  tiled=False -> [D, k] in shard order; flattening
+    # keeps shard-major order so the stable argsort resolves cost ties to
+    # the lowest global node index.
+    all_cost = jax.lax.all_gather(cand_cost, NODE_AXIS).reshape(-1)
+    all_gidx = jax.lax.all_gather(cand_gidx, NODE_AXIS).reshape(-1)
+    order = jnp.argsort(all_cost, stable=True)[:max_nodes]
+    sel_cost = all_cost[order]
+    sel_gidx = all_gidx[order]
+
+    k_mask = jnp.arange(max_nodes) < node_num
+    sel = ok & k_mask & jnp.isfinite(sel_cost)
+    chosen = jnp.where(sel, sel_gidx, -1)
+
+    # Apply updates for winners this shard owns.  OOB sentinel + drop mode
+    # (negative indices would wrap, so clamp explicitly).
+    local = sel_gidx - offset
+    owned = sel & (local >= 0) & (local < local_n)
+    scatter_idx = jnp.where(owned, local, local_n)  # local_n == OOB
+    avail, cost = apply_placement(avail, cost, total, req, time_limit,
+                                  scatter_idx, owned)
+    return avail, cost, ok, chosen, reason
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "mesh"))
+def solve_greedy_sharded(state: ClusterState, jobs: JobBatch, mesh: Mesh,
+                         max_nodes: int = 1
+                         ) -> tuple[Placements, ClusterState]:
+    """Greedy in-priority-order placement with the node axis sharded.
+
+    Same contract as ``models.solver.solve_greedy``; requires the node count
+    to be divisible by the mesh size (callers pad dead nodes, which never
+    match).  The returned state keeps its node-sharded layout so successive
+    cycles never regather the cluster to one device.
+    """
+    max_nodes = min(max_nodes, state.num_nodes)
+
+    def shard_fn(avail, total, alive, cost, req, node_num, time_limit,
+                 part_mask, valid):
+        def step(carry, job):
+            a, c = carry
+            jreq, jnn, jtl, jpm, jv = job
+            a, c, ok, chosen, reason = _place_one_shard(
+                a, c, total, alive, jreq, jnn, jtl, jpm, jv, max_nodes)
+            return (a, c), (ok, chosen, reason)
+
+        (avail, cost), (placed, nodes, reason) = jax.lax.scan(
+            step, (avail, cost),
+            (req, node_num, time_limit, part_mask, valid))
+        return avail, cost, placed, nodes, reason
+
+    node_row = P(NODE_AXIS)
+    node_mat = P(NODE_AXIS, None)
+    avail, cost, placed, nodes, reason = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(node_mat, node_mat, node_row, node_row,
+                  P(None, None), P(None), P(None), P(None, NODE_AXIS),
+                  P(None)),
+        out_specs=(node_mat, node_row, P(None), P(None, None), P(None)),
+        check_vma=False,
+    )(state.avail, state.total, state.alive, state.cost,
+      jobs.req, jobs.node_num, jobs.time_limit, jobs.part_mask, jobs.valid)
+
+    new_state = state.replace(avail=avail, cost=cost)
+    return Placements(placed=placed, nodes=nodes, reason=reason), new_state

@@ -1,0 +1,124 @@
+"""NumPy transcription of MultiFactorPriority — the parity reference.
+
+Direct, loop-for-loop transcription of the reference's sorter
+(src/CraneCtld/JobScheduler.cpp: CalculateFactorBound_ :7633-7754 and
+CalculatePriority_ :7757-7819) in plain Python so it is obviously-correct
+and diffable against the vectorized models/priority.py.
+
+Jobs are dicts; accounts are plain strings like the C++ map keys, so the
+transcription carries none of the dense-account-axis encoding the device
+code uses.  Computed in float32 to match the device (the reference uses
+double; only the ordering is contractual, but our two implementations must
+agree bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+f32 = np.float32
+
+
+def multifactor_priority_oracle(pending, running, weights):
+    """pending/running: list[dict]; weights: dict with keys
+    age/partition/job_size/fair_share/qos/favor_small/max_age.
+    Returns np.float32[len(pending)] priorities.
+
+    All job attributes are unsigned in the reference (uint32/uint64);
+    negative inputs are clamped to 0, matching the device implementation.
+    """
+    clamp = lambda j: {k: (max(v, 0) if isinstance(v, (int, float)) else v)
+                       for k, v in j.items()}
+    pending = [clamp(j) for j in pending]
+    running = [clamp(j) for j in running]
+    # --- CalculateFactorBound_ ---
+    age_max, age_min = 0.0, np.inf
+    qos_max, qos_min = 0.0, np.inf
+    part_max, part_min = 0.0, np.inf
+    nodes_max, nodes_min = 0.0, np.inf
+    mem_max, mem_min = 0.0, np.inf
+    cpus_max, cpus_min = 0.0, np.inf
+    acc_service = {}
+
+    for job in pending:
+        age = min(job["age"], weights["max_age"])
+        acc_service[job["account"]] = f32(0.0)
+        age_min, age_max = min(age, age_min), max(age, age_max)
+        nodes_min = min(job["node_num"], nodes_min)
+        nodes_max = max(job["node_num"], nodes_max)
+        mem_min, mem_max = min(job["mem"], mem_min), max(job["mem"], mem_max)
+        cpus_min = min(job["cpus"], cpus_min)
+        cpus_max = max(job["cpus"], cpus_max)
+        qos_min, qos_max = min(job["qos"], qos_min), max(job["qos"], qos_max)
+        part_min = min(job["part"], part_min)
+        part_max = max(job["part"], part_max)
+
+    for job in running:
+        nodes_min = min(job["node_num"], nodes_min)
+        nodes_max = max(job["node_num"], nodes_max)
+        mem_min, mem_max = min(job["mem"], mem_min), max(job["mem"], mem_max)
+        cpus_min = min(job["cpus"], cpus_min)
+        cpus_max = max(job["cpus"], cpus_max)
+        qos_min, qos_max = min(job["qos"], qos_min), max(job["qos"], qos_max)
+        part_min = min(job["part"], part_min)
+        part_max = max(job["part"], part_max)
+
+    for job in running:
+        service_val = f32(0.0)
+        if cpus_max > cpus_min:
+            service_val += f32(job["cpus"] - cpus_min) / f32(cpus_max
+                                                             - cpus_min)
+        else:
+            service_val += f32(1.0)
+        if nodes_max > nodes_min:
+            service_val += f32(job["node_num"] - nodes_min) / f32(nodes_max
+                                                                  - nodes_min)
+        else:
+            service_val += f32(1.0)
+        if mem_max > mem_min:
+            service_val += f32(job["mem"] - mem_min) / f32(mem_max - mem_min)
+        else:
+            service_val += f32(1.0)
+        prev = acc_service.get(job["account"], f32(0.0))
+        acc_service[job["account"]] = f32(prev
+                                          + service_val * f32(job["run_time"]))
+
+    sv_min, sv_max = np.inf, 0.0
+    for val in acc_service.values():
+        sv_min, sv_max = min(val, sv_min), max(val, sv_max)
+
+    # --- CalculatePriority_ per pending job ---
+    out = np.zeros(len(pending), f32)
+    for i, job in enumerate(pending):
+        age = min(job["age"], weights["max_age"])
+        age_f = f32(0.0)
+        if age_max > age_min:
+            age_f = f32(age - age_min) / f32(age_max - age_min)
+        qos_f = f32(0.0)
+        if qos_max > qos_min:
+            qos_f = f32(job["qos"] - qos_min) / f32(qos_max - qos_min)
+        part_f = f32(0.0)
+        if part_max > part_min:
+            part_f = f32(job["part"] - part_min) / f32(part_max - part_min)
+        size_f = f32(0.0)
+        if cpus_max > cpus_min:
+            size_f += f32(job["cpus"] - cpus_min) / f32(cpus_max - cpus_min)
+        if nodes_max > nodes_min:
+            size_f += f32(job["node_num"] - nodes_min) / f32(nodes_max
+                                                             - nodes_min)
+        if mem_max > mem_min:
+            size_f += f32(job["mem"] - mem_min) / f32(mem_max - mem_min)
+        if weights["favor_small"]:
+            size_f = f32(1.0) - f32(size_f) / f32(3.0)
+        else:
+            size_f = f32(size_f) / f32(3.0)
+        fshare_f = f32(0.0)
+        if sv_max > sv_min:
+            fshare_f = f32(1.0) - (f32(acc_service[job["account"]] - sv_min)
+                                   / f32(sv_max - sv_min))
+        out[i] = (f32(weights["age"]) * age_f
+                  + f32(weights["partition"]) * part_f
+                  + f32(weights["job_size"]) * size_f
+                  + f32(weights["fair_share"]) * fshare_f
+                  + f32(weights["qos"]) * qos_f)
+    return out

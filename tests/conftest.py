@@ -3,13 +3,30 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a forced 8-device CPU platform (the driver separately dry-runs
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment's sitecustomize (PYTHONPATH=/root/.axon_site) imports jax at
+interpreter startup and registers the ``axon`` TPU plugin, so by the time this
+conftest runs (a) the env var JAX_PLATFORMS is already captured and (b) jax is
+already imported.  Setting os.environ here is therefore NOT enough (round-1
+advisor finding: the suite hung on the axon plugin).  jax.config.update()
+still works at this point because no backend has been initialized yet; the
+XLA_FLAGS env var is also still honored since backends read it lazily at
+first use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read lazily at first backend initialization, so setting it
+# here (after sitecustomize imported jax, before any backend exists) works.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# THE load-bearing line: the JAX_PLATFORMS env var was already captured into
+# jax.config when sitecustomize imported jax, so only config.update (not
+# os.environ) can force CPU at this point.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,216 @@
+"""MultiFactorPriority parity: vectorized device sorter vs NumPy transcription
+of the reference (src/CraneCtld/JobScheduler.cpp:7606-7819)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cranesched_tpu.models.priority import (
+    PendingPriorityAttrs,
+    PriorityWeights,
+    RunningPriorityAttrs,
+    multifactor_priority,
+    priority_order,
+)
+from cranesched_tpu.testing.priority_oracle import multifactor_priority_oracle
+
+DEFAULT_W = dict(age=500.0, partition=1000.0, job_size=0.0,
+                 fair_share=10000.0, qos=1000000.0, favor_small=True,
+                 max_age=14 * 24 * 3600)
+
+
+def _random_jobs(rng, n, accounts, running=False):
+    jobs = []
+    for _ in range(n):
+        j = dict(
+            age=int(rng.integers(0, 20 * 24 * 3600)),
+            qos=int(rng.integers(0, 5)) * 1000,
+            part=int(rng.integers(0, 3)) * 100,
+            node_num=int(rng.integers(1, 16)),
+            cpus=float(rng.integers(1, 256)) / 4.0,
+            mem=float(rng.integers(1, 1 << 20)),
+            account=accounts[int(rng.integers(0, len(accounts)))],
+        )
+        if running:
+            j["run_time"] = int(rng.integers(0, 48 * 3600))
+        jobs.append(j)
+    return jobs
+
+
+def _to_device(pending, running, accounts, pad_p=0, pad_r=0):
+    acc_idx = {a: i for i, a in enumerate(accounts)}
+    J, R = len(pending) + pad_p, len(running) + pad_r
+
+    def col(jobs, key, pad, dt):
+        vals = [j[key] for j in jobs] + [0] * pad
+        return jnp.asarray(np.array(vals, dtype=dt))
+
+    p = PendingPriorityAttrs(
+        age=col(pending, "age", pad_p, np.int32),
+        qos_prio=col(pending, "qos", pad_p, np.int32),
+        part_prio=col(pending, "part", pad_p, np.int32),
+        node_num=col(pending, "node_num", pad_p, np.int32),
+        cpus=col(pending, "cpus", pad_p, np.float32),
+        mem=col(pending, "mem", pad_p, np.float32),
+        account=jnp.asarray(
+            np.array([acc_idx[j["account"]] for j in pending]
+                     + [0] * pad_p, np.int32)),
+        valid=jnp.asarray(np.array([True] * len(pending)
+                                   + [False] * pad_p, dtype=bool)),
+    )
+    r = RunningPriorityAttrs(
+        qos_prio=col(running, "qos", pad_r, np.int32),
+        part_prio=col(running, "part", pad_r, np.int32),
+        node_num=col(running, "node_num", pad_r, np.int32),
+        cpus=col(running, "cpus", pad_r, np.float32),
+        mem=col(running, "mem", pad_r, np.float32),
+        account=jnp.asarray(
+            np.array([acc_idx[j["account"]] for j in running]
+                     + [0] * pad_r, np.int32)),
+        run_time=col(running, "run_time", pad_r, np.int32),
+        valid=jnp.asarray(np.array([True] * len(running)
+                                   + [False] * pad_r, dtype=bool)),
+    )
+    return p, r
+
+
+def _check_parity(pending, running, accounts, weights=None,
+                  pad_p=0, pad_r=0):
+    wd = dict(DEFAULT_W, **(weights or {}))
+    want = multifactor_priority_oracle(pending, running, wd)
+    p, r = _to_device(pending, running, accounts, pad_p, pad_r)
+    w = PriorityWeights(age=wd["age"], partition=wd["partition"],
+                        job_size=wd["job_size"],
+                        fair_share=wd["fair_share"], qos=wd["qos"],
+                        favor_small=wd["favor_small"],
+                        max_age=wd["max_age"])
+    got = np.asarray(multifactor_priority(p, r, w, len(accounts)))
+    np.testing.assert_allclose(got[: len(pending)], want, rtol=2e-6,
+                               atol=1e-3)
+    if pad_p:
+        assert np.all(np.isneginf(got[len(pending):]))
+    return got
+
+
+def test_single_job_degenerate_bounds():
+    # One pending job, nothing running: every bound degenerate -> all
+    # factors 0 except job_size (favor_small -> 1.0 with zero terms), and
+    # the default W_jobsize is 0, so the priority is exactly 0.
+    pending = [dict(age=100, qos=1000, part=100, node_num=2, cpus=4.0,
+                    mem=1024.0, account="a")]
+    got = _check_parity(pending, [], ["a"])
+    assert got[0] == np.float32(0.0)
+    # with a job-size weight it is W_jobsize * 1.0
+    got = _check_parity(pending, [], ["a"], weights=dict(job_size=123.0))
+    assert got[0] == np.float32(123.0)
+
+
+def test_age_factor_ordering():
+    pending = [
+        dict(age=age, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a")
+        for age in (10, 1000, 500)
+    ]
+    got = _check_parity(pending, [], ["a"])
+    order = np.asarray(priority_order(jnp.asarray(got)))
+    assert list(order) == [1, 2, 0]  # oldest first
+
+
+def test_age_clipped_to_max_age():
+    pending = [
+        dict(age=10 ** 9, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=DEFAULT_W["max_age"], qos=0, part=0, node_num=1, cpus=1.0,
+             mem=1.0, account="a"),
+        dict(age=0, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+    ]
+    got = _check_parity(pending, [], ["a"])
+    # both clipped ages are identical
+    assert got[0] == got[1] and got[0] > got[2]
+
+
+def test_qos_dominates_with_default_weights():
+    pending = [
+        dict(age=10 ** 6, qos=0, part=200, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=0, qos=4000, part=0, node_num=8, cpus=64.0, mem=4096.0,
+             account="b"),
+    ]
+    got = _check_parity(pending, [], ["a", "b"])
+    assert got[1] > got[0]  # W_qos=1e6 dwarfs everything else
+
+
+def test_fair_share_penalizes_heavy_account():
+    running = [dict(qos=0, part=0, node_num=4, cpus=32.0, mem=8192.0,
+                    account="hog", run_time=3600)]
+    pending = [
+        dict(age=0, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="hog"),
+        dict(age=0, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="idle"),
+    ]
+    got = _check_parity(pending, running, ["hog", "idle"])
+    assert got[1] > got[0]
+
+
+def test_favor_small_flips_size_factor():
+    pending = [
+        dict(age=0, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=0, qos=0, part=0, node_num=16, cpus=128.0, mem=65536.0,
+             account="a"),
+    ]
+    big = _check_parity(pending, [], ["a"],
+                        weights=dict(job_size=5000.0, favor_small=False,
+                                     fair_share=0.0))
+    assert big[1] > big[0]
+    small = _check_parity(pending, [], ["a"],
+                          weights=dict(job_size=5000.0, favor_small=True,
+                                       fair_share=0.0))
+    assert small[0] > small[1]
+
+
+def test_running_jobs_widen_bounds():
+    # A running job with huge cpus stretches cpus bounds, shrinking the
+    # pending jobs' normalized size difference.
+    pending = [
+        dict(age=0, qos=0, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=0, qos=0, part=0, node_num=1, cpus=2.0, mem=1.0,
+             account="a"),
+    ]
+    running = [dict(qos=0, part=0, node_num=1, cpus=1000.0, mem=1.0,
+                    account="b", run_time=60)]
+    _check_parity(pending, running, ["a", "b"],
+                  weights=dict(job_size=1000.0, favor_small=False))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_parity(seed):
+    rng = np.random.default_rng(seed)
+    accounts = [f"acc{i}" for i in range(7)]
+    pending = _random_jobs(rng, 50, accounts)
+    running = _random_jobs(rng, 30, accounts, running=True)
+    _check_parity(pending, running, accounts, pad_p=14, pad_r=9)
+
+
+def test_negative_attrs_clamped_like_unsigned_reference():
+    # The reference's attrs are uint32/uint64 so negatives cannot exist;
+    # both implementations clamp to 0 and must still agree.
+    pending = [
+        dict(age=0, qos=-2000, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=0, qos=-1000, part=-5, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+        dict(age=0, qos=500, part=0, node_num=1, cpus=1.0, mem=1.0,
+             account="a"),
+    ]
+    got = _check_parity(pending, [], ["a"])
+    # both negatives clamp to qos=0 -> equal priorities below the positive
+    assert got[0] == got[1] < got[2]
+
+
+def test_priority_order_ties_stable():
+    pri = jnp.asarray(np.array([5.0, 7.0, 5.0, 7.0], np.float32))
+    assert list(np.asarray(priority_order(pri))) == [1, 3, 0, 2]

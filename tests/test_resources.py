@@ -30,6 +30,27 @@ def test_encode_mem_rounds_up():
     assert v[R.DIM_MEM] == 2
 
 
+def test_encode_capacity_rounds_down():
+    # A node's capacity must never be overstated by quantization (round-1
+    # advisor finding): capacities round down, requests round up, so a
+    # request that raw bytes would refuse can never fit after encoding.
+    lay = R.ResourceLayout()
+    cap = lay.encode(mem_bytes=2 * R.MEM_UNIT_BYTES - 1, is_capacity=True)
+    assert cap[R.DIM_MEM] == 1
+    req = lay.encode(mem_bytes=2 * R.MEM_UNIT_BYTES - 1)
+    assert req[R.DIM_MEM] == 2
+    assert not bool(R.fits(jnp.asarray(req), jnp.asarray(cap)))
+
+
+def test_layout_hashable_static_arg():
+    # The layout is jit static configuration; it must be hashable.
+    a = R.ResourceLayout.from_gres_names([("gpu", "a100")])
+    b = R.ResourceLayout.from_gres_names([("gpu", "a100")])
+    c = R.ResourceLayout()
+    assert hash(a) == hash(b) and a == b
+    assert a != c
+
+
 def test_fits_elementwise():
     lay = R.ResourceLayout.from_gres_names([("gpu", "a100")])
     avail = lay.encode(cpu=4, mem_bytes=8 << 30, gres={("gpu", "a100"): 2})

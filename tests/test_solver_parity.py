@@ -123,7 +123,8 @@ def test_fifo_order_respected():
     """Earlier (higher-priority) jobs get resources first."""
     lay = ResourceLayout()
     total = np.tile(lay.encode(cpu=4, mem_bytes=8 << 30,
-                               memsw_bytes=8 << 30), (1, 1))
+                               memsw_bytes=8 << 30, is_capacity=True),
+                    (1, 1))
     state_d = dict(avail=total.copy(), total=total,
                    alive=np.ones(1, bool),
                    cost=np.zeros(1, np.float32))
