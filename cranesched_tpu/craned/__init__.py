@@ -1,0 +1,12 @@
+"""Node plane: craned daemons.
+
+``sim`` provides in-process simulated craneds with a virtual clock — the
+integration-test seam the reference lacks (SURVEY.md §4: multi-node
+behavior was validated only on live clusters).  The real daemon
+(registration FSM, cgroups, supervisor spawning) plugs in behind the same
+stub interface.
+"""
+
+from cranesched_tpu.craned.sim import SimCluster, SimCraned
+
+__all__ = ["SimCluster", "SimCraned"]

@@ -1,0 +1,33 @@
+"""Host control plane: job lifecycle, cluster state, scheduling cycles.
+
+The TPU-native counterpart of the reference's CraneCtld process
+(reference: src/CraneCtld/).  The heavy per-cycle placement math runs on
+device (models/ + parallel/); this package owns everything around it:
+
+- ``defs``      job/step lifecycle types (reference CtldPublicDefs.h)
+- ``meta``      authoritative cluster state — nodes, partitions, resource
+                ledger, mid-cycle reduce events (CranedMetaContainer)
+- ``scheduler`` submit → cycle → commit → dispatch → status-change → free
+                (JobScheduler / ScheduleThread_)
+"""
+
+from cranesched_tpu.ctld.defs import (
+    JobSpec,
+    JobStatus,
+    PendingReason,
+    ResourceSpec,
+)
+from cranesched_tpu.ctld.meta import MetaContainer, NodeMeta, Partition
+from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
+
+__all__ = [
+    "JobScheduler",
+    "JobSpec",
+    "JobStatus",
+    "MetaContainer",
+    "NodeMeta",
+    "Partition",
+    "PendingReason",
+    "ResourceSpec",
+    "SchedulerConfig",
+]

@@ -1,0 +1,125 @@
+"""Job/step lifecycle types for the control plane.
+
+Mirrors the capability surface of the reference's public defs (reference:
+src/CraneCtld/CtldPublicDefs.h — JobInCtld :782, job status space
+protos/PublicDefs.proto TaskStatus, pending-reason strings
+docs/en/reference/pending_reason.md) without porting its object design:
+jobs here are small frozen specs + a mutable runtime record, and every
+resource quantity lives in the dense vector encoding of ops/resources.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from cranesched_tpu.ops.resources import ResourceLayout
+
+
+class JobStatus(enum.Enum):
+    """Job lifecycle (reference PublicDefs.proto TaskStatus)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    COMPLETED = "Completed"         # exit code 0
+    FAILED = "Failed"               # nonzero exit
+    EXCEED_TIME_LIMIT = "ExceedTimeLimit"
+    CANCELLED = "Cancelled"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self not in (JobStatus.PENDING, JobStatus.RUNNING)
+
+
+class PendingReason(str, enum.Enum):
+    """User-visible pending reasons (reference
+    docs/en/reference/pending_reason.md; set throughout NodeSelect and the
+    submit/cycle paths)."""
+
+    NONE = ""
+    RESOURCE = "Resource"
+    CONSTRAINT = "Constraint"  # partition/nodelist rules nodes out
+    PRIORITY = "Priority"      # cut off by the schedule batch limit
+    HELD = "Held"
+    BEGIN_TIME = "BeginTime"
+    DEPENDENCY = "Dependency"
+    DEPENDENCY_NEVER_SATISFIED = "DependencyNeverSatisfied"
+    QOS_LIMIT = "QOSResourceLimit"
+    INVALID = "InvalidSpec"
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """Per-node resource request in human units; encoded once at submit."""
+
+    cpu: float = 1.0
+    mem_bytes: int = 0
+    memsw_bytes: int = 0
+    gres: Mapping[tuple[str, str], int] | None = None
+
+    def encode(self, layout: ResourceLayout) -> np.ndarray:
+        return layout.encode(cpu=self.cpu, mem_bytes=self.mem_bytes,
+                             memsw_bytes=self.memsw_bytes, gres=self.gres)
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """What a user submits (reference JobToCtld / cbatch flags)."""
+
+    name: str = "job"
+    user: str = "user"
+    account: str = "default"
+    partition: str = "default"
+    res: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
+    node_num: int = 1
+    ntasks_per_node: int = 1
+    time_limit: int = 3600            # seconds
+    qos_priority: int = 0
+    held: bool = False
+    include_nodes: Sequence[str] = ()
+    exclude_nodes: Sequence[str] = ()
+    begin_time: float | None = None   # epoch seconds; None = now
+    requeue_if_failed: bool = False
+    # simulation-only: how long the job actually runs and its exit code
+    # (real clusters learn these when the step exits)
+    sim_runtime: float | None = None
+    sim_exit_code: int = 0
+
+
+@dataclasses.dataclass
+class Job:
+    """Runtime record the scheduler owns (reference JobInCtld,
+    CtldPublicDefs.h:782 — submit/start/end times, status, craned_ids,
+    pending reason, requeue count)."""
+
+    job_id: int
+    spec: JobSpec
+    submit_time: float
+    status: JobStatus = JobStatus.PENDING
+    held: bool = False                    # runtime hold flag (mutable;
+                                          # seeded from spec.held at submit)
+    cancel_requested: bool = False        # persisted cancel intent: survives
+                                          # races with node death (the kill
+                                          # may never be confirmed)
+    pending_reason: PendingReason = PendingReason.NONE
+    start_time: float | None = None
+    end_time: float | None = None
+    exit_code: int | None = None
+    node_ids: list[int] = dataclasses.field(default_factory=list)
+    requeue_count: int = 0
+    priority: float = 0.0
+
+    def reset_for_requeue(self) -> None:
+        """Return to pending after a failure/node-death (reference
+        ResetForRequeue, JobScheduler.cpp:6950-6965)."""
+        self.status = JobStatus.PENDING
+        self.pending_reason = PendingReason.NONE
+        self.start_time = None
+        self.end_time = None
+        self.exit_code = None
+        self.node_ids = []
+        self.requeue_count += 1
+        self.priority = 0.0

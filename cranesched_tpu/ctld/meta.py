@@ -1,0 +1,236 @@
+"""Authoritative cluster state: nodes, partitions, the resource ledger.
+
+The TPU-native counterpart of the reference's CranedMetaContainer
+(reference: src/CraneCtld/Node/CranedMetaContainer.h:31 — per-node alive/
+drain state, resource malloc/free, partition membership, and the
+ResReduceEvent log :162-196 that captures concurrent resource reductions
+during a scheduling cycle so the cycle's decisions can be re-validated
+before commit).
+
+Host-side this is plain Python + NumPy (it is the *ledger*, mutated by
+events); each cycle exports a dense device snapshot via ``snapshot()``.
+The two-phase pattern — device solve on the snapshot, host re-validation
+against the live ledger at commit — is exactly the reference's
+NodeSelect-then-ResReduceEvent-check design (JobScheduler.cpp:1437-1540).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import numpy as np
+
+from cranesched_tpu.ops.resources import ResourceLayout
+
+
+@dataclasses.dataclass
+class Partition:
+    """Reference PartitionMeta (NodeDefs.h:104-122): name, priority, node
+    membership, account ACLs."""
+
+    name: str
+    priority: int = 0
+    node_ids: set[int] = dataclasses.field(default_factory=set)
+    allowed_accounts: set[str] | None = None   # None = all
+    denied_accounts: set[str] = dataclasses.field(default_factory=set)
+
+    def account_allowed(self, account: str) -> bool:
+        if account in self.denied_accounts:
+            return False
+        return self.allowed_accounts is None or (
+            account in self.allowed_accounts)
+
+
+@dataclasses.dataclass
+class NodeMeta:
+    """Reference CranedMeta (NodeDefs.h:59-81): static total + live avail,
+    alive/drain flags, running job registry."""
+
+    node_id: int
+    name: str
+    total: np.ndarray                    # int32[R], capacity encoding
+    avail: np.ndarray                    # int32[R]
+    alive: bool = False
+    drained: bool = False
+    partitions: set[str] = dataclasses.field(default_factory=set)
+    running_jobs: set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def schedulable(self) -> bool:
+        return self.alive and not self.drained
+
+
+@dataclasses.dataclass(frozen=True)
+class ResReduceEvent:
+    """A resource reduction that happened while a cycle was in flight
+    (reference CranedMetaContainer.h:162-196): node died or was drained."""
+
+    node_id: int
+
+
+class MetaContainer:
+    """Node/partition registry + resource ledger.
+
+    Single-threaded by design: the gRPC layer serializes mutations onto the
+    scheduler loop, so per-entry locks (the reference's AtomicHashMap) are
+    unnecessary; the event log still exists because dispatch I/O can
+    interleave with cycles.
+    """
+
+    def __init__(self, layout: ResourceLayout | None = None):
+        self.layout = layout or ResourceLayout()
+        self.nodes: dict[int, NodeMeta] = {}
+        self.partitions: dict[str, Partition] = {}
+        self._name_to_id: dict[str, int] = {}
+        self._part_max_cache: dict[str, np.ndarray] = {}
+        self._events: list[ResReduceEvent] = []
+        self._logging = False
+
+    # ---- topology ----
+
+    def add_partition(self, name: str, priority: int = 0,
+                      allowed_accounts: Iterable[str] | None = None,
+                      denied_accounts: Iterable[str] = ()) -> Partition:
+        part = Partition(
+            name=name, priority=priority,
+            allowed_accounts=(set(allowed_accounts)
+                              if allowed_accounts is not None else None),
+            denied_accounts=set(denied_accounts))
+        self.partitions[name] = part
+        return part
+
+    def add_node(self, name: str, total: np.ndarray,
+                 partitions: Iterable[str] = ("default",)) -> NodeMeta:
+        node_id = len(self.nodes)
+        node = NodeMeta(node_id=node_id, name=name,
+                        total=np.asarray(total, np.int32),
+                        avail=np.asarray(total, np.int32).copy(),
+                        partitions=set(partitions))
+        self.nodes[node_id] = node
+        self._name_to_id[name] = node_id
+        for p in node.partitions:
+            if p not in self.partitions:
+                self.add_partition(p)
+            self.partitions[p].node_ids.add(node_id)
+            self._part_max_cache.pop(p, None)
+        return node
+
+    def node_by_name(self, name: str) -> NodeMeta:
+        return self.nodes[self._name_to_id[name]]
+
+    def partition_max_total(self, partition: str) -> np.ndarray:
+        """Elementwise max of node totals in a partition — the submit-time
+        'could this request ever fit one node' bound, cached so submit
+        stays O(R) instead of O(nodes)."""
+        cached = self._part_max_cache.get(partition)
+        if cached is not None:
+            return cached
+        part = self.partitions.get(partition)
+        out = np.zeros(self.layout.num_dims, np.int32)
+        if part is not None:
+            for i in part.node_ids:
+                out = np.maximum(out, self.nodes[i].total)
+        self._part_max_cache[partition] = out
+        return out
+
+    # ---- liveness (reference CranedUp/CranedDown,
+    #      CranedMetaContainer.h:105-124) ----
+
+    def craned_up(self, node_id: int) -> None:
+        self.nodes[node_id].alive = True
+
+    def craned_down(self, node_id: int) -> list[int]:
+        """Mark dead; returns running jobs that must be terminated.  Logs a
+        reduce event so an in-flight cycle revalidates."""
+        node = self.nodes[node_id]
+        node.alive = False
+        self._log_event(ResReduceEvent(node_id))
+        return sorted(node.running_jobs)
+
+    def drain(self, node_id: int, drained: bool = True) -> None:
+        self.nodes[node_id].drained = drained
+        if drained:
+            self._log_event(ResReduceEvent(node_id))
+
+    # ---- ledger (reference MallocResourceFromNode :126 / free) ----
+
+    def malloc_resource(self, job_id: int, node_ids: Iterable[int],
+                        req: np.ndarray) -> bool:
+        """Atomically subtract ``req`` from every node or none (host
+        authoritative commit; the device solve already believed it fits)."""
+        node_ids = list(node_ids)
+        nodes = [self.nodes[i] for i in node_ids]
+        if not all(n.schedulable and (req <= n.avail).all() for n in nodes):
+            return False
+        for n in nodes:
+            n.avail = n.avail - req
+            n.running_jobs.add(job_id)
+        return True
+
+    def free_resource(self, job_id: int, node_ids: Iterable[int],
+                      req: np.ndarray) -> None:
+        for i in node_ids:
+            node = self.nodes[i]
+            if job_id in node.running_jobs:
+                node.running_jobs.discard(job_id)
+                node.avail = np.minimum(node.avail + req, node.total)
+
+    # ---- mid-cycle event capture (reference StartLogging /
+    #      GetResReduceEvents, consumed at JobScheduler.cpp:1466-1540) ----
+
+    def start_logging(self) -> None:
+        self._events.clear()
+        self._logging = True
+
+    def stop_logging(self) -> list[ResReduceEvent]:
+        self._logging = False
+        events, self._events = list(self._events), []
+        return events
+
+    def _log_event(self, ev: ResReduceEvent) -> None:
+        if self._logging:
+            self._events.append(ev)
+
+    # ---- device snapshot ----
+
+    def snapshot(self):
+        """Dense SoA arrays for the device solve, aligned by node_id.
+
+        Returns (avail[N,R], total[N,R], alive[N]) as NumPy; the scheduler
+        owns moving them to device and building per-job masks.
+        """
+        n = len(self.nodes)
+        r = self.layout.num_dims
+        avail = np.zeros((n, r), np.int32)
+        total = np.zeros((n, r), np.int32)
+        alive = np.zeros(n, bool)
+        for i, node in self.nodes.items():
+            avail[i] = node.avail
+            total[i] = node.total
+            alive[i] = node.schedulable
+        return avail, total, alive
+
+    def partition_mask(self, partition: str, include: Iterable[str] = (),
+                       exclude: Iterable[str] = ()) -> np.ndarray:
+        """bool[N] eligibility from partition membership and
+        include/exclude nodelists (precomputed host-side, reference
+        GetNodesAndTrySchedule_ include/exclude handling)."""
+        n = len(self.nodes)
+        mask = np.zeros(n, bool)
+        part = self.partitions.get(partition)
+        if part is None:
+            return mask
+        for i in part.node_ids:
+            mask[i] = True
+        include = list(include)
+        if include:
+            inc = np.zeros(n, bool)
+            for name in include:
+                if name in self._name_to_id:
+                    inc[self._name_to_id[name]] = True
+            mask &= inc
+        for name in exclude:
+            if name in self._name_to_id:
+                mask[self._name_to_id[name]] = False
+        return mask

@@ -1,0 +1,529 @@
+"""JobScheduler: the submit → cycle → dispatch → status-change loop.
+
+TPU-native counterpart of the reference's JobScheduler/ScheduleThread_
+(reference: src/CraneCtld/JobScheduler.cpp — submit path
+SubmitJobToScheduler :3405, the 1 Hz scheduling cycle :1321-1981, batched
+status changes CleanJobStatusChangeQueueCb_ :5318-5488, requeue
+:6950-6965).  Differences by design, not omission:
+
+* The per-cycle placement math (priority sort + greedy node selection) is
+  a jit-compiled device solve (models/priority + models/solver, or the
+  node-sharded parallel/sharded at scale), not a C++ loop.
+* The cycle is an explicit ``schedule_cycle(now)`` call driven by the
+  daemon loop (or tests), with virtual time — no hidden threads.  The
+  reference's nine worker threads exist to multiplex queues onto cores;
+  here the queues are drained inline and the heavy math is on device.
+* Two-phase commit is kept: the device solve sees a snapshot; the host
+  ledger (MetaContainer) is authoritative at commit and re-validates
+  against mid-cycle ResReduceEvents, exactly like NodeSelect's
+  post-validation (cpp:1466-1540).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Callable, Iterable
+
+import numpy as np
+import jax.numpy as jnp
+
+from cranesched_tpu.ctld.defs import (
+    Job,
+    JobSpec,
+    JobStatus,
+    PendingReason,
+)
+from cranesched_tpu.ctld.meta import MetaContainer
+from cranesched_tpu.models.priority import (
+    PendingPriorityAttrs,
+    PriorityWeights,
+    RunningPriorityAttrs,
+    multifactor_priority,
+    priority_order,
+)
+from cranesched_tpu.models.solver import (
+    REASON_CONSTRAINT,
+    REASON_RESOURCE,
+    ClusterState,
+    JobBatch,
+    Placements,
+    make_cluster_state,
+    solve_greedy,
+)
+from cranesched_tpu.ops.resources import DIM_CPU, DIM_MEM
+
+_REASON_MAP = {
+    REASON_RESOURCE: PendingReason.RESOURCE,
+    REASON_CONSTRAINT: PendingReason.CONSTRAINT,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Reference scheduler knobs (etc/config.yaml:97-112,190-198;
+    CtldPublicDefs.h:42-60)."""
+
+    schedule_batch_size: int = 100_000
+    pending_queue_max_size: int = 900_000
+    max_nodes_per_job: int = 8          # static gang bound of the solve
+    priority_type: str = "multifactor"  # or "basic" (FIFO)
+    priority_weights: PriorityWeights = dataclasses.field(
+        default_factory=PriorityWeights)
+    max_requeue_count: int = 3
+
+
+@dataclasses.dataclass
+class StatusChange:
+    """One craned→ctld step status report (reference StepStatusChange
+    queue, JobScheduler.cpp:5294)."""
+
+    job_id: int
+    status: JobStatus
+    exit_code: int
+    time: float
+
+
+class JobScheduler:
+    """Owns the pending/running maps and drives scheduling cycles.
+
+    ``dispatch`` is called with (job, node_ids) for every committed
+    placement — the seam where the real system fans out AllocJobs RPCs and
+    tests plug a simulated cluster (the reference's testing seam is the
+    same shape: intents out, transport elsewhere).
+    """
+
+    def __init__(self, meta: MetaContainer,
+                 config: SchedulerConfig | None = None,
+                 dispatch: Callable[[Job, list[int]], None] | None = None,
+                 wal=None):
+        self.meta = meta
+        self.config = config or SchedulerConfig()
+        self.dispatch = dispatch or (lambda job, nodes: None)
+        self.wal = wal
+        self.pending: dict[int, Job] = {}    # job_id -> Job, insertion = id order
+        self.running: dict[int, Job] = {}
+        self.history: dict[int, Job] = {}    # terminal jobs
+        self._status_queue: collections.deque[StatusChange] = (
+            collections.deque())
+        self._next_job_id = 1
+        self._account_index: dict[str, int] = {}
+        self._mask_cache: dict[tuple, np.ndarray] = {}
+
+    # ------------------------------------------------------------------
+    # submit / cancel / hold (reference SubmitJobToScheduler :3405,
+    # cancel/hold queues JobScheduler.h:1239-1320)
+    # ------------------------------------------------------------------
+
+    def submit(self, spec: JobSpec, now: float) -> int:
+        """Validate and enqueue; returns job_id (0 = rejected)."""
+        if len(self.pending) >= self.config.pending_queue_max_size:
+            return 0
+        part = self.meta.partitions.get(spec.partition)
+        if part is None or not part.account_allowed(spec.account):
+            return 0
+        # gangs beyond the configured bound (or the partition size) can
+        # never be placed — reject at submit rather than leaving the job
+        # pending forever with a transient-looking reason
+        if not (1 <= spec.node_num
+                <= min(self.config.max_nodes_per_job, len(part.node_ids))):
+            return 0
+        # CheckJobValidity analog: the per-node request must fit at least
+        # one node's *total* in the partition, else it can never run.
+        req = spec.res.encode(self.meta.layout)
+        if not (req <= self.meta.partition_max_total(spec.partition)).all():
+            return 0
+
+        job_id = self._next_job_id
+        self._next_job_id += 1
+        job = Job(job_id=job_id, spec=spec, submit_time=now,
+                  held=spec.held)
+        if spec.held:
+            job.pending_reason = PendingReason.HELD
+        self.pending[job_id] = job
+        if self.wal is not None:
+            self.wal.job_submitted(job)
+        return job_id
+
+    def cancel(self, job_id: int, now: float) -> bool:
+        if job_id in self.pending:
+            job = self.pending.pop(job_id)
+            job.status = JobStatus.CANCELLED
+            job.end_time = now
+            self._finalize(job)
+            return True
+        if job_id in self.running:
+            # real system: TerminateSteps RPC → craned kills → status
+            # change flows back.  The dispatch seam owns the kill; the
+            # status change arrives via step_status_change.  The intent is
+            # recorded on the job AND WAL-logged so neither a node death
+            # racing the kill nor a ctld crash can resurrect the job.
+            job = self.running[job_id]
+            job.cancel_requested = True
+            if self.wal is not None:
+                self.wal.job_updated(job)
+            self.dispatch_terminate(job_id, now)
+            return True
+        return False
+
+    def dispatch_terminate(self, job_id: int, now: float) -> None:
+        """Overridden/patched by the transport layer; simulated clusters
+        hook this to deliver a Cancelled status change."""
+
+    def hold(self, job_id: int, held: bool, now: float) -> bool:
+        job = self.pending.get(job_id)
+        if job is None:
+            return False
+        job.held = held
+        job.pending_reason = (PendingReason.HELD if held
+                              else PendingReason.NONE)
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        return True
+
+    # ------------------------------------------------------------------
+    # status changes (reference StepStatusChangeAsync :5294 + batched
+    # drain :5318)
+    # ------------------------------------------------------------------
+
+    def step_status_change(self, job_id: int, status: JobStatus,
+                           exit_code: int, now: float) -> None:
+        self._status_queue.append(
+            StatusChange(job_id, status, exit_code, now))
+
+    def process_status_changes(self) -> int:
+        """Drain the queue (cycle step 1).  Returns #processed."""
+        n = 0
+        while self._status_queue:
+            ch = self._status_queue.popleft()
+            job = self.running.get(ch.job_id)
+            if job is None:
+                continue
+            n += 1
+            self._release_job_resources(job)
+            del self.running[ch.job_id]
+            job.end_time = ch.time
+            job.exit_code = ch.exit_code
+            job.status = ch.status
+            if self._should_requeue(job, ch):
+                job.reset_for_requeue()
+                if job.requeue_count > self.config.max_requeue_count:
+                    # over the cap: requeued but held (reference keeps the
+                    # job, operator must release)
+                    job.held = True
+                    job.pending_reason = PendingReason.HELD
+                self.pending[job.job_id] = job
+                if self.wal is not None:
+                    self.wal.job_requeued(job)
+            else:
+                self._finalize(job)
+        return n
+
+    def _should_requeue(self, job: Job, ch: StatusChange) -> bool:
+        """Reference ShouldRequeue (CtldPublicDefs tests :397-457):
+        user-requested requeue-if-failed, or system failure (craned
+        death), bounded by MaxRequeueCount."""
+        if job.cancel_requested:
+            return False
+        if ch.status == JobStatus.FAILED and job.spec.requeue_if_failed:
+            return True
+        return False
+
+    def _release_job_resources(self, job: Job) -> None:
+        req = job.spec.res.encode(self.meta.layout)
+        self.meta.free_resource(job.job_id, job.node_ids, req)
+
+    def _finalize(self, job: Job) -> None:
+        self.history[job.job_id] = job
+        if self.wal is not None:
+            self.wal.job_finalized(job)
+
+    # ------------------------------------------------------------------
+    # node failure (reference CranedDown → TerminateJobsOnCraned,
+    # JobScheduler.h:1076; EC_CRANED_DOWN requeue)
+    # ------------------------------------------------------------------
+
+    def on_craned_down(self, node_id: int, now: float) -> list[int]:
+        """Node died: terminate its jobs; system-failure auto-requeue up
+        to MaxRequeueCount, then held (CtldPublicDefs.h:101-102)."""
+        victim_ids = self.meta.craned_down(node_id)
+        for job_id in victim_ids:
+            job = self.running.get(job_id)
+            if job is None:
+                continue
+            self._release_job_resources(job)
+            del self.running[job_id]
+            if job.cancel_requested:
+                # the kill we sent can no longer be confirmed; honor the
+                # user's cancel instead of resurrecting the job
+                job.status = JobStatus.CANCELLED
+                job.end_time = now
+                self._finalize(job)
+                continue
+            job.reset_for_requeue()
+            if job.requeue_count > self.config.max_requeue_count:
+                # same terminal behavior as the status-change path:
+                # requeued but held, operator must release
+                job.held = True
+                job.pending_reason = PendingReason.HELD
+            self.pending[job_id] = job
+            if self.wal is not None:
+                self.wal.job_requeued(job)
+        return victim_ids
+
+    # ------------------------------------------------------------------
+    # THE scheduling cycle (reference ScheduleThread_ :1321-1981)
+    # ------------------------------------------------------------------
+
+    def schedule_cycle(self, now: float) -> list[int]:
+        """One cycle: drain status changes, snapshot, device solve, commit,
+        dispatch.  Returns the job_ids started this cycle."""
+        self.process_status_changes()
+
+        candidates = self._pending_candidates(now)
+        if not candidates:
+            return []
+        limit = self.config.schedule_batch_size
+        if len(candidates) > limit:
+            for job in candidates[limit:]:
+                job.pending_reason = PendingReason.PRIORITY
+            candidates = candidates[:limit]
+
+        # snapshot + event capture window (cpp:1437)
+        self.meta.start_logging()
+        avail, total, alive = self.meta.snapshot()
+
+        ordered = self._priority_sort(candidates, now)
+        jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0])
+        state = make_cluster_state(avail, total, alive)
+        placements, _ = solve_greedy(state, jobs_batch,
+                                     max_nodes=max_nodes)
+
+        return self._commit(ordered, placements, now)
+
+    def _pending_candidates(self, now: float) -> list[Job]:
+        """Skip held / future-begin-time jobs (cpp:1374-1413); dependency
+        gating joins here once dependencies land."""
+        out = []
+        for job in self.pending.values():  # id order == insertion order
+            if job.held:
+                job.pending_reason = PendingReason.HELD
+                continue
+            if job.spec.begin_time is not None and (
+                    job.spec.begin_time > now):
+                job.pending_reason = PendingReason.BEGIN_TIME
+                continue
+            out.append(job)
+        return out
+
+    def _account_id(self, account: str) -> int:
+        if account not in self._account_index:
+            self._account_index[account] = len(self._account_index)
+        return self._account_index[account]
+
+    def _priority_sort(self, candidates: list[Job], now: float
+                       ) -> list[Job]:
+        if self.config.priority_type == "basic" or not candidates:
+            return candidates  # FIFO: id order (JobScheduler.h:183-201)
+
+        lay = self.meta.layout
+        for job in candidates:
+            self._account_id(job.spec.account)
+        for job in self.running.values():
+            self._account_id(job.spec.account)
+        # bucketed: num_accounts is a jit static arg, and the dense index
+        # grows monotonically — pad so new accounts rarely recompile
+        num_accounts = self._bucket(len(self._account_index))
+
+        def job_row(job: Job):
+            req = job.spec.res.encode(lay)
+            total_cpu = float(req[DIM_CPU]) / 256.0 * job.spec.node_num
+            total_mem = float(req[DIM_MEM]) * job.spec.node_num
+            return (job.spec.qos_priority,
+                    self.meta.partitions[job.spec.partition].priority,
+                    job.spec.node_num, total_cpu, total_mem,
+                    self._account_id(job.spec.account))
+
+        # pad both batches to bucketed shapes (same rationale as
+        # _build_batch: keep the jit cache small)
+        JP = self._bucket(len(candidates))
+        p_rows = [job_row(j) for j in candidates]
+
+        def col(rows, k, dt, size):
+            arr = np.zeros(size, dt)
+            arr[: len(rows)] = [r[k] for r in rows]
+            return jnp.asarray(arr)
+
+        age = np.zeros(JP, np.int32)
+        age[: len(candidates)] = [max(now - j.submit_time, 0.0)
+                                  for j in candidates]
+        p_valid = np.zeros(JP, bool)
+        p_valid[: len(candidates)] = True
+        pending = PendingPriorityAttrs(
+            age=jnp.asarray(age),
+            qos_prio=col(p_rows, 0, np.int32, JP),
+            part_prio=col(p_rows, 1, np.int32, JP),
+            node_num=col(p_rows, 2, np.int32, JP),
+            cpus=col(p_rows, 3, np.float32, JP),
+            mem=col(p_rows, 4, np.float32, JP),
+            account=col(p_rows, 5, np.int32, JP),
+            valid=jnp.asarray(p_valid))
+
+        r_jobs = list(self.running.values())
+        RP = self._bucket(len(r_jobs)) if r_jobs else 16
+        r_rows = [job_row(j) for j in r_jobs]
+        run_time = np.zeros(RP, np.int32)
+        run_time[: len(r_jobs)] = [max(now - (j.start_time or now), 0.0)
+                                   for j in r_jobs]
+        r_valid = np.zeros(RP, bool)
+        r_valid[: len(r_jobs)] = True
+        running = RunningPriorityAttrs(
+            qos_prio=col(r_rows, 0, np.int32, RP),
+            part_prio=col(r_rows, 1, np.int32, RP),
+            node_num=col(r_rows, 2, np.int32, RP),
+            cpus=col(r_rows, 3, np.float32, RP),
+            mem=col(r_rows, 4, np.float32, RP),
+            account=col(r_rows, 5, np.int32, RP),
+            run_time=jnp.asarray(run_time),
+            valid=jnp.asarray(r_valid))
+
+        pri = np.asarray(multifactor_priority(
+            pending, running, self.config.priority_weights, num_accounts))
+        order = np.asarray(priority_order(jnp.asarray(pri)))
+        order = order[order < len(candidates)]  # drop -inf padding rows
+        for job, p in zip(candidates, pri):
+            job.priority = float(p)
+        return [candidates[i] for i in order]
+
+    @staticmethod
+    def _bucket(n: int, floor: int = 16) -> int:
+        """Pad counts to the next power of two so the jitted solve sees a
+        small set of static shapes (a fresh XLA compile per distinct J
+        would dominate every cycle)."""
+        b = floor
+        while b < n:
+            b *= 2
+        return b
+
+    def _mask_for(self, job: Job) -> np.ndarray:
+        key = (job.spec.partition, tuple(job.spec.include_nodes),
+               tuple(job.spec.exclude_nodes), len(self.meta.nodes))
+        mask = self._mask_cache.get(key)
+        if mask is None:
+            mask = self.meta.partition_mask(
+                job.spec.partition, job.spec.include_nodes,
+                job.spec.exclude_nodes)
+            self._mask_cache[key] = mask
+        return mask
+
+    def _build_batch(self, ordered: list[Job], num_nodes: int
+                     ) -> tuple[JobBatch, int]:
+        lay = self.meta.layout
+        J = self._bucket(len(ordered))
+        req = np.zeros((J, lay.num_dims), np.int32)
+        node_num = np.zeros(J, np.int32)
+        time_limit = np.zeros(J, np.int32)
+        part_mask = np.zeros((J, num_nodes), bool)
+        valid = np.zeros(J, bool)
+        for i, job in enumerate(ordered):
+            req[i] = job.spec.res.encode(lay)
+            node_num[i] = job.spec.node_num
+            time_limit[i] = job.spec.time_limit
+            part_mask[i] = self._mask_for(job)
+            valid[i] = True
+        max_nodes = max(1, min(int(node_num.max(initial=1)),
+                               self.config.max_nodes_per_job))
+        # bucket the static gang bound too (it is a jit static arg)
+        max_nodes = self._bucket(max_nodes, floor=1)
+        batch = JobBatch(req=jnp.asarray(req),
+                         node_num=jnp.asarray(node_num),
+                         time_limit=jnp.asarray(time_limit),
+                         part_mask=jnp.asarray(part_mask),
+                         valid=jnp.asarray(valid))
+        return batch, max_nodes
+
+    def _commit(self, ordered: list[Job], placements: Placements,
+                now: float) -> list[int]:
+        """Host authoritative commit + dispatch (cpp:1557-1839): re-check
+        against the live ledger and the cycle's reduce events; jobs whose
+        nodes died mid-cycle simply stay pending for the next cycle."""
+        events = self.meta.stop_logging()
+        dirty_nodes = {ev.node_id for ev in events}
+
+        placed = np.asarray(placements.placed)
+        nodes_mat = np.asarray(placements.nodes)
+        reasons = np.asarray(placements.reason)
+        started: list[int] = []
+        for i, job in enumerate(ordered):
+            if not placed[i]:
+                job.pending_reason = _REASON_MAP.get(
+                    int(reasons[i]), PendingReason.RESOURCE)
+                continue
+            node_ids = [int(n) for n in nodes_mat[i] if n >= 0]
+            if dirty_nodes.intersection(node_ids):
+                job.pending_reason = PendingReason.RESOURCE
+                continue
+            req = job.spec.res.encode(self.meta.layout)
+            if not self.meta.malloc_resource(job.job_id, node_ids, req):
+                job.pending_reason = PendingReason.RESOURCE
+                continue
+            del self.pending[job.job_id]
+            job.status = JobStatus.RUNNING
+            job.start_time = now
+            job.node_ids = node_ids
+            job.pending_reason = PendingReason.NONE
+            self.running[job.job_id] = job
+            if self.wal is not None:
+                self.wal.job_started(job)
+            self.dispatch(job, node_ids)
+            started.append(job.job_id)
+        return started
+
+    # ------------------------------------------------------------------
+    # recovery (reference JobScheduler::Init, JobScheduler.cpp:191-1091:
+    # re-queue pending via RequeueRecoveredJobIntoPendingQueueLock_ :1120,
+    # re-adopt running via PutRecoveredJobIntoRunningQueueLock_ :1139)
+    # ------------------------------------------------------------------
+
+    def recover(self, replayed: dict, now: float = 0.0) -> None:
+        """Rebuild queues from a WAL replay (``WriteAheadLog.replay``).
+
+        Classification is by the job's recorded *status*, not the event
+        name, so any durable mutation (cancel intent, hold) recovers too:
+        terminal → history; RUNNING → re-adopted WITH resources re-applied
+        to the ledger (the craneds still run them — the reference
+        reconciles with each craned at re-registration; the simulated
+        plane re-dispatches); anything else → pending.
+        """
+        for job_id, (event, job) in sorted(replayed.items()):
+            self._next_job_id = max(self._next_job_id, job_id + 1)
+            if job.status.is_terminal:
+                self.history[job_id] = job
+            elif job.status == JobStatus.RUNNING:
+                req = job.spec.res.encode(self.meta.layout)
+                if self.meta.malloc_resource(job_id, job.node_ids, req):
+                    self.running[job_id] = job
+                    if job.cancel_requested:
+                        # the kill may have been lost with the crash;
+                        # re-send it
+                        self.dispatch_terminate(job_id, now)
+                else:
+                    # node vanished while we were down -> requeue, unless
+                    # the user had already cancelled
+                    if job.cancel_requested:
+                        job.status = JobStatus.CANCELLED
+                        job.end_time = now
+                        self.history[job_id] = job
+                        continue
+                    job.reset_for_requeue()
+                    self.pending[job_id] = job
+            else:
+                job.status = JobStatus.PENDING
+                self.pending[job_id] = job
+
+    def job_info(self, job_id: int) -> Job | None:
+        return (self.pending.get(job_id) or self.running.get(job_id)
+                or self.history.get(job_id))
+
+    def queue(self) -> list[Job]:
+        return list(self.pending.values()) + list(self.running.values())

@@ -1,0 +1,158 @@
+"""Write-ahead log of job runtime state + restart recovery.
+
+The reference keeps every pending/running job's runtime attributes in an
+embedded KV store (unqlite/BerkeleyDB behind IEmbeddedDb, reference:
+src/CraneCtld/Database/EmbeddedDbClient.h:85-204), written BEFORE dispatch
+and updated on every status change, then purged once the job is terminal
+and archived to MongoDB.  On restart, JobScheduler::Init
+(JobScheduler.cpp:191-1091) replays it: pending jobs re-queue, running
+jobs are re-adopted.
+
+Here the WAL is an append-only JSON-lines file — human-debuggable, crash
+append-atomic (one line per event, fsync'd), and replayable in one pass.
+Terminal jobs are retained as ``finalized`` tombstones; ``compact()``
+rewrites the live prefix the way the reference purges finalized rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import IO
+
+from cranesched_tpu.ctld.defs import Job, JobSpec, JobStatus, PendingReason, ResourceSpec
+
+
+def _spec_to_dict(spec: JobSpec) -> dict:
+    d = dataclasses.asdict(spec)
+    res = d.pop("res")
+    gres = res.pop("gres")
+    res["gres"] = ([[list(k), v] for k, v in gres.items()]
+                   if gres else None)
+    d["res"] = res
+    d["include_nodes"] = list(spec.include_nodes)
+    d["exclude_nodes"] = list(spec.exclude_nodes)
+    return d
+
+
+def _spec_from_dict(d: dict) -> JobSpec:
+    d = dict(d)
+    res = dict(d.pop("res"))
+    gres = res.pop("gres")
+    res["gres"] = ({tuple(k): v for k, v in gres} if gres else None)
+    d["res"] = ResourceSpec(**res)
+    d["include_nodes"] = tuple(d.get("include_nodes") or ())
+    d["exclude_nodes"] = tuple(d.get("exclude_nodes") or ())
+    return JobSpec(**d)
+
+
+def _job_to_dict(job: Job) -> dict:
+    return {
+        "job_id": job.job_id,
+        "spec": _spec_to_dict(job.spec),
+        "submit_time": job.submit_time,
+        "status": job.status.name,
+        "held": job.held,
+        "cancel_requested": job.cancel_requested,
+        "pending_reason": job.pending_reason.name,
+        "start_time": job.start_time,
+        "end_time": job.end_time,
+        "exit_code": job.exit_code,
+        "node_ids": job.node_ids,
+        "requeue_count": job.requeue_count,
+    }
+
+
+def _job_from_dict(d: dict) -> Job:
+    return Job(
+        job_id=d["job_id"],
+        spec=_spec_from_dict(d["spec"]),
+        submit_time=d["submit_time"],
+        status=JobStatus[d["status"]],
+        held=d["held"],
+        cancel_requested=d.get("cancel_requested", False),
+        pending_reason=PendingReason[d["pending_reason"]],
+        start_time=d["start_time"],
+        end_time=d["end_time"],
+        exit_code=d["exit_code"],
+        node_ids=list(d["node_ids"]),
+        requeue_count=d["requeue_count"],
+    )
+
+
+class WriteAheadLog:
+    """Append-only event log; each event carries the job's full runtime
+    record so replay is last-writer-wins per job_id."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._fh: IO[str] = open(path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def _append(self, event: str, job: Job) -> None:
+        rec = {"ev": event, "job": _job_to_dict(job)}
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    # -- the lifecycle hooks the scheduler calls --
+
+    def job_submitted(self, job: Job) -> None:
+        self._append("submit", job)
+
+    def job_started(self, job: Job) -> None:
+        self._append("start", job)
+
+    def job_requeued(self, job: Job) -> None:
+        self._append("requeue", job)
+
+    def job_updated(self, job: Job) -> None:
+        """Any other durable mutation: cancel intent, hold/release."""
+        self._append("update", job)
+
+    def job_finalized(self, job: Job) -> None:
+        self._append("finalize", job)
+
+    # -- recovery --
+
+    @staticmethod
+    def replay(path: str) -> dict[int, tuple[str, Job]]:
+        """Last-writer-wins replay: job_id -> (last event, job record)."""
+        state: dict[int, tuple[str, Job]] = {}
+        if not os.path.exists(path):
+            return state
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a crash
+                job = _job_from_dict(rec["job"])
+                state[job.job_id] = (rec["ev"], job)
+        return state
+
+    def compact(self, live: dict[int, tuple[str, Job]] | None = None
+                ) -> None:
+        """Rewrite the log keeping only non-terminal jobs (the purge the
+        reference does after archiving to MongoDB)."""
+        live = live if live is not None else self.replay(self.path)
+        self._fh.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as out:
+            for job_id, (ev, job) in sorted(live.items()):
+                if job.status.is_terminal:
+                    continue
+                out.write(json.dumps({"ev": ev, "job": _job_to_dict(job)},
+                                     separators=(",", ":")) + "\n")
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")

@@ -1,0 +1,324 @@
+"""End-to-end control-plane tests: submit → cycle → dispatch →
+status-change → free, against the simulated craned cluster.
+
+Mirrors the reference's lifecycle semantics (SURVEY.md §3.2/§3.4:
+ScheduleThread_ JobScheduler.cpp:1321-1981, status changes :5294-5488,
+requeue :6950, craned death JobScheduler.h:1076)."""
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+
+
+def make_cluster(num_nodes=4, cpu=8, mem_gb=16, partitions=("default",),
+                 config=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        part = partitions[i % len(partitions)]
+        meta.add_node(
+            f"cn{i:02d}",
+            meta.layout.encode(cpu=cpu, mem_bytes=mem_gb << 30,
+                               memsw_bytes=mem_gb << 30, is_capacity=True),
+            partitions=(part,))
+    for i in range(num_nodes):
+        meta.craned_up(i)
+    sched = JobScheduler(meta, config or SchedulerConfig())
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+    return meta, sched, cluster
+
+
+def spec(cpu=1.0, mem_gb=1, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=mem_gb << 30,
+                                    memsw_bytes=mem_gb << 30), **kw)
+
+
+def test_single_job_lifecycle():
+    meta, sched, cluster = make_cluster()
+    jid = sched.submit(spec(cpu=2.0, sim_runtime=30.0), now=0.0)
+    assert jid == 1
+    assert sched.job_info(jid).status == JobStatus.PENDING
+
+    started = sched.schedule_cycle(now=1.0)
+    assert started == [jid]
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.RUNNING and len(job.node_ids) == 1
+    # resources were subtracted
+    node = meta.nodes[job.node_ids[0]]
+    assert node.avail[0] == node.total[0] - 2 * 256
+
+    cluster.advance_to(40.0)
+    sched.schedule_cycle(now=41.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.COMPLETED
+    assert job.exit_code == 0 and job.end_time == 31.0
+    assert (node.avail == node.total).all()  # freed
+
+
+def test_drain_10k_jobs_1k_nodes():
+    """BASELINE config #1 shape (scaled to CI budget): FIFO end-to-end."""
+    meta, sched, cluster = make_cluster(
+        num_nodes=1000, cpu=16, mem_gb=64,
+        config=SchedulerConfig(priority_type="basic"))
+    rng = np.random.default_rng(0)
+    for i in range(10_000):
+        jid = sched.submit(
+            spec(cpu=float(rng.integers(1, 9)),
+                 mem_gb=int(rng.integers(1, 17)),
+                 sim_runtime=float(rng.integers(10, 300)),
+                 time_limit=3600),
+            now=0.0)
+        assert jid == i + 1
+    end = cluster.run_until_drained(start=0.0, max_cycles=2000)
+    assert len(sched.history) == 10_000
+    assert all(j.status == JobStatus.COMPLETED
+               for j in sched.history.values())
+    # ledger returned to full
+    for node in meta.nodes.values():
+        assert (node.avail == node.total).all()
+    assert end < 10_000  # drained in bounded virtual time
+
+
+def test_no_oversubscription_every_instant():
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=4)
+    for i in range(20):
+        sched.submit(spec(cpu=3.0, sim_runtime=10.0), now=0.0)
+    now = 0.0
+    for _ in range(300):
+        cluster.advance_to(now)
+        sched.schedule_cycle(now)
+        for node in meta.nodes.values():
+            assert (node.avail >= 0).all()
+        if not sched.pending and not sched.running:
+            break
+        now += 1.0
+    assert len(sched.history) == 20
+    # only one 3-cpu job fits a 4-cpu node at a time -> serialized
+    assert all(j.status == JobStatus.COMPLETED
+               for j in sched.history.values())
+
+
+def test_cancel_pending_and_running():
+    meta, sched, cluster = make_cluster()
+    j1 = sched.submit(spec(sim_runtime=100.0), now=0.0)
+    j2 = sched.submit(spec(sim_runtime=100.0), now=0.0)
+    assert sched.cancel(j1, now=0.5)
+    assert sched.job_info(j1).status == JobStatus.CANCELLED
+
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(j2).status == JobStatus.RUNNING
+    assert sched.cancel(j2, now=2.0)
+    sched.schedule_cycle(now=3.0)  # drains the status change
+    job = sched.job_info(j2)
+    assert job.status == JobStatus.CANCELLED
+    for node in meta.nodes.values():
+        assert (node.avail == node.total).all()
+
+
+def test_cancel_survives_node_death_race():
+    # Cancel a running job, then kill its node BEFORE the kill confirmation
+    # drains: the persisted cancel intent must win over the system-failure
+    # requeue (the reference tracks the cancel on the job in ctld).
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=4)
+    jid = sched.submit(spec(cpu=4.0, sim_runtime=100.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    node = sched.job_info(jid).node_ids[0]
+    sched.cancel(jid, now=1.0)
+    sched.on_craned_down(node, now=2.0)
+    cluster.advance_to(200.0)
+    sched.schedule_cycle(now=200.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.CANCELLED
+    assert job.requeue_count == 0
+
+
+def test_hold_release():
+    meta, sched, cluster = make_cluster()
+    jid = sched.submit(spec(held=True, sim_runtime=5.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.PENDING
+    assert job.pending_reason == PendingReason.HELD
+    sched.hold(jid, False, now=2.0)
+    assert sched.schedule_cycle(now=3.0) == [jid]
+
+
+def test_begin_time_gates_start():
+    meta, sched, cluster = make_cluster()
+    jid = sched.submit(spec(begin_time=100.0, sim_runtime=5.0), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == []
+    assert sched.job_info(jid).pending_reason == PendingReason.BEGIN_TIME
+    assert sched.schedule_cycle(now=100.0) == [jid]
+
+
+def test_time_limit_exceeded():
+    meta, sched, cluster = make_cluster()
+    jid = sched.submit(spec(sim_runtime=1000.0, time_limit=60), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(61.0)
+    sched.schedule_cycle(now=61.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.EXCEED_TIME_LIMIT
+    assert job.end_time == 60.0
+
+
+def test_failed_job_requeue_then_held():
+    meta, sched, cluster = make_cluster(
+        config=SchedulerConfig(max_requeue_count=2))
+    jid = sched.submit(spec(sim_runtime=5.0, sim_exit_code=1,
+                            requeue_if_failed=True), now=0.0)
+    end = None
+    now = 0.0
+    for _ in range(50):
+        cluster.advance_to(now)
+        sched.schedule_cycle(now)
+        job = sched.job_info(jid)
+        if job.held:
+            end = now
+            break
+        now += 1.0
+    assert end is not None
+    job = sched.job_info(jid)
+    assert job.requeue_count == 3  # 3 attempts -> exceeded cap of 2
+    assert job.status == JobStatus.PENDING and job.held
+
+
+def test_craned_down_requeues_jobs():
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=4)
+    j1 = sched.submit(spec(cpu=3.0, sim_runtime=100.0), now=0.0)
+    j2 = sched.submit(spec(cpu=3.0, sim_runtime=100.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert sched.job_info(j1).status == JobStatus.RUNNING
+    assert sched.job_info(j2).status == JobStatus.RUNNING
+
+    dead = sched.job_info(j1).node_ids[0]
+    victims = sched.on_craned_down(dead, now=10.0)
+    assert victims == [j1]
+    job = sched.job_info(j1)
+    assert job.status == JobStatus.PENDING and job.requeue_count == 1
+    # dead node unschedulable; the job lands on the survivor once free
+    started = sched.schedule_cycle(now=11.0)
+    assert started == []  # survivor still busy with j2
+    assert sched.job_info(j1).pending_reason == PendingReason.RESOURCE
+    cluster.advance_to(101.0)
+    sched.schedule_cycle(now=101.0)
+    assert sched.job_info(j1).status == JobStatus.RUNNING
+    assert sched.job_info(j1).node_ids != [dead]
+
+
+def test_stale_completion_does_not_finish_requeued_job():
+    # a completion event queued by the FIRST dispatch must not complete the
+    # job's second incarnation after a node-death requeue
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=4)
+    jid = sched.submit(spec(cpu=4.0, sim_runtime=100.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    first_node = sched.job_info(jid).node_ids[0]
+    sched.on_craned_down(first_node, now=10.0)
+    started = sched.schedule_cycle(now=11.0)   # re-placed on the survivor
+    assert started == [jid]
+    # the stale event (due at t=100) must be ignored; the real completion
+    # is at 11 + 100 = 111
+    cluster.advance_to(105.0)
+    sched.schedule_cycle(now=105.0)
+    assert sched.job_info(jid).status == JobStatus.RUNNING
+    cluster.advance_to(112.0)
+    sched.schedule_cycle(now=112.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.COMPLETED
+    assert job.end_time == 111.0
+
+
+def test_cancel_timestamp_not_stale():
+    # the Cancelled status change must carry the ctld cancel time even when
+    # the simulated cluster clock lags behind
+    meta, sched, cluster = make_cluster()
+    jid = sched.submit(spec(sim_runtime=100.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    sched.cancel(jid, now=5.0)   # cluster.now is still 0.0
+    sched.schedule_cycle(now=6.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.CANCELLED
+    assert job.end_time == 5.0 >= job.start_time
+
+
+def test_submit_rejects_oversized_gang():
+    meta, sched, cluster = make_cluster(num_nodes=4)
+    # gang larger than the partition can never run -> rejected at submit
+    assert sched.submit(spec(node_num=5), now=0.0) == 0
+    # gang beyond the configured solver bound likewise
+    cfg = SchedulerConfig(max_nodes_per_job=2)
+    meta2, sched2, _ = make_cluster(num_nodes=4, config=cfg)
+    assert sched2.submit(spec(node_num=3), now=0.0) == 0
+    assert sched2.submit(spec(node_num=2), now=0.0) > 0
+
+
+def test_partition_isolation_and_acl():
+    meta, sched, cluster = make_cluster(num_nodes=4,
+                                        partitions=("cpu", "gpu"))
+    meta.partitions["gpu"].allowed_accounts = {"ml"}
+    # wrong account for gpu partition -> rejected at submit
+    assert sched.submit(spec(partition="gpu", account="hpc"), now=0.0) == 0
+    jid = sched.submit(spec(partition="gpu", account="ml",
+                            sim_runtime=5.0), now=0.0)
+    assert jid > 0
+    sched.schedule_cycle(now=1.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.RUNNING
+    assert all(
+        "gpu" in meta.nodes[n].partitions for n in job.node_ids)
+
+
+def test_submit_rejects_impossible_request():
+    meta, sched, cluster = make_cluster(cpu=8)
+    assert sched.submit(spec(cpu=64.0), now=0.0) == 0  # never fits
+
+
+def test_gang_job_spans_nodes():
+    meta, sched, cluster = make_cluster(num_nodes=4, cpu=8)
+    jid = sched.submit(spec(cpu=8.0, node_num=3, sim_runtime=10.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.RUNNING
+    assert len(set(job.node_ids)) == 3
+    for n in job.node_ids:
+        assert meta.nodes[n].avail[0] == 0
+    cluster.advance_to(20.0)
+    sched.schedule_cycle(now=20.0)
+    for n in meta.nodes.values():
+        assert (n.avail == n.total).all()
+
+
+def test_multifactor_priority_orders_cycle():
+    meta, sched, cluster = make_cluster(num_nodes=1, cpu=4)
+    # one node, one slot: high-qos job submitted later must start first
+    lo = sched.submit(spec(cpu=4.0, qos_priority=0, sim_runtime=10.0),
+                      now=0.0)
+    hi = sched.submit(spec(cpu=4.0, qos_priority=1000, sim_runtime=10.0),
+                      now=1.0)
+    started = sched.schedule_cycle(now=2.0)
+    assert started == [hi]
+    assert sched.job_info(lo).pending_reason == PendingReason.RESOURCE
+
+
+def test_schedule_batch_size_sets_priority_reason():
+    meta, sched, cluster = make_cluster(
+        config=SchedulerConfig(schedule_batch_size=1,
+                               priority_type="basic"))
+    j1 = sched.submit(spec(sim_runtime=5.0), now=0.0)
+    j2 = sched.submit(spec(sim_runtime=5.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(j1).status == JobStatus.RUNNING
+    j2_info = sched.job_info(j2)
+    assert j2_info.status == JobStatus.PENDING
+    assert j2_info.pending_reason == PendingReason.PRIORITY
