@@ -42,7 +42,9 @@ class PendingReason(str, enum.Enum):
     NONE = ""
     RESOURCE = "Resource"
     CONSTRAINT = "Constraint"  # partition/nodelist rules nodes out
-    PRIORITY = "Priority"      # cut off by the schedule batch limit
+    PRIORITY = "Priority"      # cut off by the schedule batch limit, or
+                               # resources free but a higher-priority
+                               # reservation would be delayed
     HELD = "Held"
     BEGIN_TIME = "BeginTime"
     DEPENDENCY = "Dependency"

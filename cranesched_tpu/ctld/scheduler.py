@@ -51,7 +51,12 @@ from cranesched_tpu.models.solver import (
     make_cluster_state,
     solve_greedy,
 )
-from cranesched_tpu.ops.resources import DIM_CPU, DIM_MEM
+from cranesched_tpu.models.solver_time import (
+    TimedJobBatch,
+    make_timed_state,
+    solve_backfill,
+)
+from cranesched_tpu.ops.resources import CPU_SCALE, DIM_CPU, DIM_MEM
 
 _REASON_MAP = {
     REASON_RESOURCE: PendingReason.RESOURCE,
@@ -71,6 +76,15 @@ class SchedulerConfig:
     priority_weights: PriorityWeights = dataclasses.field(
         default_factory=PriorityWeights)
     max_requeue_count: int = 3
+    # time axis: duration-aware fit + conservative backfill (reference
+    # TimeAvailResMap + EarliestStartSubsetSelector; the grid analog of
+    # Slurm's bf_resolution).  Each solve step costs O(N * time_buckets
+    # * R) vs O(N * R) for the immediate solver — ~time_buckets× heavier.
+    # At very large scale either lower time_buckets or set backfill=False
+    # (Slurm similarly separates its sched and bf passes).
+    backfill: bool = True
+    time_resolution: float = 60.0       # seconds per bucket
+    time_buckets: int = 64              # horizon = resolution * buckets
 
 
 @dataclasses.dataclass
@@ -295,11 +309,67 @@ class JobScheduler:
 
         ordered = self._priority_sort(candidates, now)
         jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0])
-        state = make_cluster_state(avail, total, alive)
-        placements, _ = solve_greedy(state, jobs_batch,
-                                     max_nodes=max_nodes)
+        cost0 = self._initial_cost(now, total)
 
-        return self._commit(ordered, placements, now)
+        if self.config.backfill:
+            state = self._timed_state(now, avail, total, alive, cost0)
+            tbatch = self._timed_batch(jobs_batch, ordered)
+            placements, _ = solve_backfill(state, tbatch,
+                                           max_nodes=max_nodes)
+            start_buckets = np.asarray(placements.start_bucket)
+        else:
+            state = make_cluster_state(avail, total, alive, cost0)
+            placements, _ = solve_greedy(state, jobs_batch,
+                                         max_nodes=max_nodes)
+            start_buckets = None
+
+        return self._commit(ordered, placements, now, start_buckets)
+
+    def _initial_cost(self, now: float, total: np.ndarray) -> np.ndarray:
+        """Per-cycle node cost seeded from running jobs' remaining
+        cpu-time (reference NodeRater, JobScheduler.h:499-516:
+        cost = Σ (end - now) * cpu / cpu_total)."""
+        cost = np.zeros(total.shape[0], np.float32)
+        for job in self.running.values():
+            end = (job.start_time or now) + job.spec.time_limit
+            remaining = max(end - now, 0.0)
+            cpus = job.spec.res.cpu
+            for n in job.node_ids:
+                cpu_total = max(float(total[n, DIM_CPU]) / CPU_SCALE, 1e-9)
+                cost[n] += np.float32(remaining * cpus / cpu_total)
+        return cost
+
+    def _timed_state(self, now, avail, total, alive, cost0):
+        res = self.config.time_resolution
+        T = self.config.time_buckets
+        r_jobs = list(self.running.values())
+        M = max(len(r_jobs), 1)
+        K = max((len(j.node_ids) for j in r_jobs), default=1)
+        run_nodes = np.full((M, K), -1, np.int32)
+        run_req = np.zeros((M, self.meta.layout.num_dims), np.int32)
+        run_end = np.full(M, T, np.int32)
+        for i, job in enumerate(r_jobs):
+            run_nodes[i, : len(job.node_ids)] = job.node_ids
+            run_req[i] = job.spec.res.encode(self.meta.layout)
+            end = (job.start_time or now) + job.spec.time_limit
+            # overdue jobs (end <= now) are about to be killed but still
+            # hold resources: release no earlier than bucket 1
+            run_end[i] = max(int(np.ceil((end - now) / res)), 1)
+        return make_timed_state(avail, total, alive, run_nodes, run_req,
+                                run_end, T, cost0)
+
+    def _timed_batch(self, batch: JobBatch, ordered: list[Job]
+                     ) -> TimedJobBatch:
+        res = self.config.time_resolution
+        T = self.config.time_buckets
+        # derive durations from the batch itself so they cannot diverge
+        # from time_limit (padding rows clip to 1 bucket, still invalid)
+        dur = np.clip(np.ceil(np.asarray(batch.time_limit) / res),
+                      1, T).astype(np.int32)
+        return TimedJobBatch(req=batch.req, node_num=batch.node_num,
+                             time_limit=batch.time_limit,
+                             dur_buckets=jnp.asarray(dur),
+                             part_mask=batch.part_mask, valid=batch.valid)
 
     def _pending_candidates(self, now: float) -> list[Job]:
         """Skip held / future-begin-time jobs (cpp:1374-1413); dependency
@@ -443,10 +513,15 @@ class JobScheduler:
         return batch, max_nodes
 
     def _commit(self, ordered: list[Job], placements: Placements,
-                now: float) -> list[int]:
+                now: float, start_buckets=None) -> list[int]:
         """Host authoritative commit + dispatch (cpp:1557-1839): re-check
         against the live ledger and the cycle's reduce events; jobs whose
-        nodes died mid-cycle simply stay pending for the next cycle."""
+        nodes died mid-cycle simply stay pending for the next cycle.
+
+        With the time axis, ``start_buckets`` marks future-start jobs:
+        they hold in-cycle reservations and surface the "Priority" reason
+        (the reference's flow at cpp:6795-6835) — only bucket-0 starts
+        dispatch."""
         events = self.meta.stop_logging()
         dirty_nodes = {ev.node_id for ev in events}
 
@@ -458,6 +533,19 @@ class JobScheduler:
             if not placed[i]:
                 job.pending_reason = _REASON_MAP.get(
                     int(reasons[i]), PendingReason.RESOURCE)
+                continue
+            if start_buckets is not None and start_buckets[i] > 0:
+                # reference cpp:6797-6835: a future-start job reports
+                # "Resource" when its chosen nodes lack free resources
+                # right now, and "Priority" only when resources are free
+                # but running would delay a higher-priority reservation
+                node_ids = [int(n) for n in nodes_mat[i] if n >= 0]
+                req = job.spec.res.encode(self.meta.layout)
+                fits_now = all(
+                    (req <= self.meta.nodes[n].avail).all()
+                    for n in node_ids) if node_ids else False
+                job.pending_reason = (PendingReason.PRIORITY if fits_now
+                                      else PendingReason.RESOURCE)
                 continue
             node_ids = [int(n) for n in nodes_mat[i] if n >= 0]
             if dirty_nodes.intersection(node_ids):

@@ -1,0 +1,105 @@
+"""NumPy oracle for the time-axis solve — the parity reference.
+
+Loop transcription of the grid scheduling semantics pinned in
+models/solver_time.py (which itself mirrors the reference's
+min-over-window fit, cpp:6278-6291, and earliest-start subset selection,
+JobScheduler.h:792-865, on a uniform bucket grid).  Obviously-correct
+nested loops, no vectorization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cranesched_tpu.models.solver import (
+    REASON_CONSTRAINT,
+    REASON_NONE,
+    REASON_RESOURCE,
+)
+from cranesched_tpu.models.solver_time import NO_START
+from cranesched_tpu.ops.resources import DIM_CPU
+
+
+def build_time_avail_oracle(avail, run_nodes, run_req, run_end_bucket,
+                            num_buckets):
+    """time_avail[n, t] = ledger avail + releases of running jobs whose
+    end bucket <= t."""
+    n, r = np.asarray(avail).shape
+    ta = np.tile(np.asarray(avail, np.int64)[:, None, :],
+                 (1, num_buckets, 1))
+    for job_nodes, req, eb in zip(run_nodes, run_req, run_end_bucket):
+        if eb >= num_buckets:
+            continue
+        for node in job_nodes:
+            if node < 0:
+                continue
+            ta[node, max(eb, 0):, :] += np.asarray(req, np.int64)
+    return ta
+
+
+def solve_backfill_oracle(time_avail, total, alive, cost, req, node_num,
+                          time_limit, dur_buckets, part_mask, valid,
+                          max_nodes):
+    """Same contract as models.solver_time.solve_backfill, in loops.
+
+    Returns (placed[J], start[J], nodes[J, max_nodes], reason[J],
+    time_avail', cost')."""
+    ta = np.array(time_avail, np.int64)
+    cost = np.array(cost, np.float32)
+    total = np.asarray(total)
+    alive = np.asarray(alive, bool)
+    N, T, R = ta.shape
+    J = len(req)
+
+    placed = np.zeros(J, bool)
+    start = np.full(J, int(NO_START), np.int64)
+    nodes_out = np.full((J, max_nodes), -1, np.int32)
+    reason = np.zeros(J, np.int32)
+
+    for j in range(J):
+        if not valid[j] or node_num[j] <= 0 or node_num[j] > max_nodes:
+            eligible = alive & part_mask[j]
+            bad = (not valid[j]) or node_num[j] <= 0
+            reason[j] = (REASON_CONSTRAINT
+                         if bad or eligible.sum() < node_num[j]
+                         else REASON_RESOURCE)
+            continue
+        eligible = alive & part_mask[j]
+        d = int(dur_buckets[j])
+
+        # ok[n, s]: node n fits req for every bucket in [s, min(s+d, T))
+        ok = np.zeros((N, T), bool)
+        for n in range(N):
+            if not eligible[n]:
+                continue
+            for s in range(T):
+                e = min(s + d, T)
+                ok[n, s] = bool(
+                    np.all(req[j][None, :] <= ta[n, s:e]))
+        s_found = -1
+        for s in range(T):
+            if ok[:, s].sum() >= node_num[j]:
+                s_found = s
+                break
+        if s_found < 0:
+            reason[j] = (REASON_RESOURCE
+                         if eligible.sum() >= node_num[j]
+                         else REASON_CONSTRAINT)
+            continue
+
+        order = np.argsort(np.where(ok[:, s_found], cost, np.inf),
+                           kind="stable")
+        chosen = order[: node_num[j]]
+        e = min(s_found + d, T)
+        for n in chosen:
+            ta[n, s_found:e] -= req[j]
+            cpu_total = max(int(total[n, DIM_CPU]), 1)
+            cost[n] = np.float32(
+                cost[n] + np.float32(time_limit[j])
+                * np.float32(req[j, DIM_CPU]) / np.float32(cpu_total))
+        placed[j] = True
+        start[j] = s_found
+        nodes_out[j, : node_num[j]] = chosen
+        reason[j] = REASON_NONE
+
+    return placed, start, nodes_out, reason, ta, cost

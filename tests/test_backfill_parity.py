@@ -1,0 +1,229 @@
+"""Time-axis solver parity + backfill behavior.
+
+Semantics under test (reference: min-over-duration-window fit,
+src/CraneCtld/JobScheduler.cpp:6278-6291; earliest-start selection
+JobScheduler.h:792-865; in-cycle reservations + "Priority" reason
+cpp:6795-6835)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cranesched_tpu.models.solver_time import (
+    NO_START,
+    TimedJobBatch,
+    make_timed_state,
+    solve_backfill,
+)
+from cranesched_tpu.ops.resources import ResourceLayout
+from cranesched_tpu.testing.time_oracle import (
+    build_time_avail_oracle,
+    solve_backfill_oracle,
+)
+
+LAY = ResourceLayout()
+T = 16
+
+
+def make_state(avail, total, alive=None, cost=None, run=None,
+               num_buckets=T):
+    avail = np.asarray(avail)
+    n = avail.shape[0]
+    alive = np.ones(n, bool) if alive is None else np.asarray(alive)
+    cost = (np.zeros(n, np.float32) if cost is None
+            else np.asarray(cost, np.float32))
+    if run is None:
+        run_nodes = np.zeros((0, 1), np.int32)
+        run_req = np.zeros((0, avail.shape[1]), np.int32)
+        run_end = np.zeros(0, np.int32)
+    else:
+        run_nodes, run_req, run_end = run
+    state = make_timed_state(avail, total, alive, run_nodes, run_req,
+                             run_end, num_buckets, cost)
+    oracle_ta = build_time_avail_oracle(avail, run_nodes, run_req, run_end,
+                                        num_buckets)
+    np.testing.assert_array_equal(np.asarray(state.time_avail), oracle_ta)
+    return state, oracle_ta, alive, cost
+
+
+def make_jobs(reqs, node_nums, durs, part_mask=None, valid=None,
+              time_limits=None, num_nodes=None):
+    J = len(reqs)
+    req = np.stack(reqs).astype(np.int32)
+    nn = np.asarray(node_nums, np.int32)
+    db = np.asarray(durs, np.int32)
+    tl = (np.asarray(time_limits, np.int32) if time_limits is not None
+          else db * 60)
+    pm = (np.ones((J, num_nodes), bool) if part_mask is None
+          else np.asarray(part_mask))
+    v = np.ones(J, bool) if valid is None else np.asarray(valid)
+    return TimedJobBatch(req=jnp.asarray(req), node_num=jnp.asarray(nn),
+                         time_limit=jnp.asarray(tl),
+                         dur_buckets=jnp.asarray(db),
+                         part_mask=jnp.asarray(pm),
+                         valid=jnp.asarray(v)), (req, nn, tl, db, pm, v)
+
+
+def assert_parity(state, oracle_ta, alive, cost, jobs, cols, max_nodes):
+    req, nn, tl, db, pm, v = cols
+    placements, new_state = solve_backfill(state, jobs,
+                                           max_nodes=max_nodes)
+    o_placed, o_start, o_nodes, o_reason, o_ta, o_cost = \
+        solve_backfill_oracle(oracle_ta, np.asarray(state.total), alive,
+                              cost, req, nn, tl, db, pm, v, max_nodes)
+    np.testing.assert_array_equal(np.asarray(placements.placed), o_placed)
+    got_start = np.asarray(placements.start_bucket)
+    np.testing.assert_array_equal(np.where(o_placed, got_start, 0),
+                                  np.where(o_placed, o_start, 0))
+    np.testing.assert_array_equal(np.asarray(placements.nodes), o_nodes)
+    np.testing.assert_array_equal(np.asarray(placements.reason), o_reason)
+    np.testing.assert_array_equal(np.asarray(new_state.time_avail), o_ta)
+    np.testing.assert_allclose(np.asarray(new_state.cost), o_cost,
+                               rtol=1e-6)
+    return placements
+
+
+def test_immediate_fit_starts_at_zero():
+    total = np.tile(LAY.encode(cpu=8, is_capacity=True), (2, 1))
+    state, ota, alive, cost = make_state(total.copy(), total)
+    jobs, cols = make_jobs([LAY.encode(cpu=4)], [1], [4], num_nodes=2)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=1)
+    assert bool(p.placed[0]) and int(p.start_bucket[0]) == 0
+
+
+def test_blocked_job_reserves_future_start():
+    # node fully busy until bucket 5; a blocked job must get start=5
+    total = np.tile(LAY.encode(cpu=8, is_capacity=True), (1, 1))
+    avail = np.tile(LAY.encode(cpu=0, is_capacity=True), (1, 1))
+    run = (np.array([[0]], np.int32),
+           np.array([LAY.encode(cpu=8)], np.int32),
+           np.array([5], np.int32))
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    jobs, cols = make_jobs([LAY.encode(cpu=8)], [1], [4], num_nodes=1)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=1)
+    assert bool(p.placed[0]) and int(p.start_bucket[0]) == 5
+
+
+def test_backfill_around_blocked_high_priority_job():
+    """THE backfill scenario: a short low-priority job may run now because
+    it finishes before the blocked high-priority job's reserved start; a
+    long one may not."""
+    # one node 8 cpu; running job holds 8 cpu until bucket 6
+    total = np.tile(LAY.encode(cpu=8, is_capacity=True), (1, 1))
+    avail = np.tile(LAY.encode(cpu=0, is_capacity=True), (1, 1))
+    run = (np.array([[0]], np.int32),
+           np.array([LAY.encode(cpu=8)], np.int32),
+           np.array([6], np.int32))
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    # job0 (high prio): needs 8 cpu -> reserved at bucket 6
+    # job1 (short, 4 cpu? no — node has 0 free until 6). Use 2 nodes.
+    total = np.tile(LAY.encode(cpu=8, is_capacity=True), (2, 1))
+    avail = np.stack([LAY.encode(cpu=0, is_capacity=True),
+                      LAY.encode(cpu=8, is_capacity=True)])
+    run = (np.array([[0]], np.int32),
+           np.array([LAY.encode(cpu=8)], np.int32),
+           np.array([6], np.int32))
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    jobs, cols = make_jobs(
+        [LAY.encode(cpu=8), LAY.encode(cpu=8), LAY.encode(cpu=8)],
+        [2, 1, 1],        # job0 gang of 2 -> must wait for node0
+        [4, 6, 8],        # job1 fits before bucket 6 on node1; job2 not
+        num_nodes=2)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=2)
+    # job0: earliest both nodes free for 4 buckets = bucket 6
+    assert int(p.start_bucket[0]) == 6
+    # job1: node1 free buckets [0, 6) -> backfills NOW
+    assert int(p.start_bucket[1]) == 0
+    # job2: needs 8 consecutive buckets on node1 but job0's reservation
+    # occupies node1 from bucket 6 -> earliest after job0 ends (bucket 10)
+    assert int(p.start_bucket[2]) == 10
+
+
+def test_reservation_not_violated_by_later_jobs():
+    # job0 reserves the future; job1 (same shape) must queue behind it,
+    # NOT steal the same window
+    total = np.tile(LAY.encode(cpu=4, is_capacity=True), (1, 1))
+    avail = np.tile(LAY.encode(cpu=0, is_capacity=True), (1, 1))
+    run = (np.array([[0]], np.int32),
+           np.array([LAY.encode(cpu=4)], np.int32),
+           np.array([2], np.int32))
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    jobs, cols = make_jobs(
+        [LAY.encode(cpu=4), LAY.encode(cpu=4)], [1, 1], [3, 3],
+        num_nodes=1)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=1)
+    assert int(p.start_bucket[0]) == 2
+    assert int(p.start_bucket[1]) == 5  # strictly after job0's window
+
+
+def test_window_longer_than_horizon_uses_steady_state():
+    # a job longer than the horizon can still start if the steady state
+    # fits (all running jobs released before the horizon)
+    total = np.tile(LAY.encode(cpu=4, is_capacity=True), (1, 1))
+    state, ota, alive, cost = make_state(total.copy(), total)
+    jobs, cols = make_jobs([LAY.encode(cpu=4)], [1], [T + 5],
+                           num_nodes=1)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=1)
+    assert bool(p.placed[0]) and int(p.start_bucket[0]) == 0
+
+
+def test_unschedulable_in_window_gets_resource_reason():
+    # node busy past the horizon -> no start bucket exists
+    total = np.tile(LAY.encode(cpu=4, is_capacity=True), (1, 1))
+    avail = np.tile(LAY.encode(cpu=0, is_capacity=True), (1, 1))
+    run = (np.array([[0]], np.int32),
+           np.array([LAY.encode(cpu=4)], np.int32),
+           np.array([T + 1], np.int32))   # never frees inside window
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    jobs, cols = make_jobs([LAY.encode(cpu=4)], [1], [2], num_nodes=1)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=1)
+    assert not bool(p.placed[0])
+
+
+def test_gang_needs_simultaneous_window():
+    # two nodes free at different times: gang of 2 starts when BOTH free
+    total = np.tile(LAY.encode(cpu=4, is_capacity=True), (2, 1))
+    avail = np.tile(LAY.encode(cpu=0, is_capacity=True), (2, 1))
+    run = (np.array([[0], [1]], np.int32),
+           np.array([LAY.encode(cpu=4), LAY.encode(cpu=4)], np.int32),
+           np.array([3, 7], np.int32))
+    state, ota, alive, cost = make_state(avail, total, run=run)
+    jobs, cols = make_jobs([LAY.encode(cpu=4)], [2], [2], num_nodes=2)
+    p = assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=2)
+    assert int(p.start_bucket[0]) == 7
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_parity(seed):
+    rng = np.random.default_rng(seed)
+    N, J, M = 12, 24, 10
+    total = np.stack([
+        LAY.encode(cpu=int(rng.integers(4, 17)),
+                   mem_bytes=int(rng.integers(8, 65)) << 30,
+                   is_capacity=True) for _ in range(N)])
+    # running jobs eat into avail
+    run_nodes = rng.integers(0, N, size=(M, 1)).astype(np.int32)
+    run_req = np.stack([
+        LAY.encode(cpu=int(rng.integers(1, 5)),
+                   mem_bytes=int(rng.integers(1, 9)) << 30)
+        for _ in range(M)]).astype(np.int32)
+    run_end = rng.integers(1, T + 3, size=M).astype(np.int32)
+    avail = total.copy()
+    for i in range(M):
+        avail[run_nodes[i, 0]] -= run_req[i]
+    avail = np.maximum(avail, 0)
+    alive = rng.random(N) > 0.1
+    cost = (rng.random(N) * 5).astype(np.float32)
+    state, ota, alive, cost = make_state(
+        avail, total, alive, cost, run=(run_nodes, run_req, run_end))
+    reqs = [LAY.encode(cpu=int(rng.integers(1, 9)),
+                       mem_bytes=int(rng.integers(1, 33)) << 30)
+            for _ in range(J)]
+    jobs, cols = make_jobs(
+        reqs, rng.integers(1, 4, J), rng.integers(1, T + 2, J),
+        part_mask=rng.random((J, N)) > 0.15,
+        valid=rng.random(J) > 0.05, num_nodes=N)
+    assert_parity(state, ota, alive, cost, jobs, cols, max_nodes=4)
+    # invariant: no bucket anywhere ever oversubscribed
+    placements, new_state = solve_backfill(state, jobs, max_nodes=4)
+    assert (np.asarray(new_state.time_avail) >= 0).all()

@@ -70,7 +70,7 @@ def test_drain_10k_jobs_1k_nodes():
     """BASELINE config #1 shape (scaled to CI budget): FIFO end-to-end."""
     meta, sched, cluster = make_cluster(
         num_nodes=1000, cpu=16, mem_gb=64,
-        config=SchedulerConfig(priority_type="basic"))
+        config=SchedulerConfig(priority_type="basic", backfill=False))
     rng = np.random.default_rng(0)
     for i in range(10_000):
         jid = sched.submit(
@@ -210,6 +210,8 @@ def test_craned_down_requeues_jobs():
     # dead node unschedulable; the job lands on the survivor once free
     started = sched.schedule_cycle(now=11.0)
     assert started == []  # survivor still busy with j2
+    # future reservation exists but the chosen node lacks free resources
+    # NOW -> "Resource" (reference cpp:6797-6822)
     assert sched.job_info(j1).pending_reason == PendingReason.RESOURCE
     cluster.advance_to(101.0)
     sched.schedule_cycle(now=101.0)
@@ -299,6 +301,38 @@ def test_gang_job_spans_nodes():
         assert (n.avail == n.total).all()
 
 
+def test_backfill_short_job_runs_despite_blocked_high_priority():
+    # 2 nodes, 4 cpu each; a gang-of-2 high-qos job is blocked by a
+    # running job on node A.  A short low-priority job must backfill onto
+    # node B NOW (it ends before the gang's reserved start); a long one
+    # must NOT (it would delay the reservation).
+    meta, sched, cluster = make_cluster(
+        num_nodes=2, cpu=4,
+        config=SchedulerConfig(time_resolution=60.0, time_buckets=16))
+    blocker = sched.submit(spec(cpu=4.0, sim_runtime=600.0,
+                                time_limit=600), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert sched.job_info(blocker).status == JobStatus.RUNNING
+
+    gang = sched.submit(spec(cpu=4.0, node_num=2, qos_priority=1000,
+                             time_limit=300, sim_runtime=300.0), now=1.0)
+    long_lo = sched.submit(spec(cpu=4.0, qos_priority=0, time_limit=1200,
+                                sim_runtime=1200.0), now=1.0)
+    short_lo = sched.submit(spec(cpu=4.0, qos_priority=0, time_limit=300,
+                                 sim_runtime=300.0), now=1.0)
+    started = sched.schedule_cycle(now=2.0)
+    # only the short job starts (fits before the gang's start at ~600s)
+    assert started == [short_lo]
+    # the gang's node A is busy now -> "Resource" (cpp:6797-6822)
+    assert sched.job_info(gang).pending_reason == PendingReason.RESOURCE
+    assert sched.job_info(long_lo).pending_reason in (
+        PendingReason.PRIORITY, PendingReason.RESOURCE)
+    # everything drains eventually
+    cluster.run_until_drained(start=3.0, max_cycles=5000)
+    assert all(j.status == JobStatus.COMPLETED
+               for j in sched.history.values())
+
+
 def test_multifactor_priority_orders_cycle():
     meta, sched, cluster = make_cluster(num_nodes=1, cpu=4)
     # one node, one slot: high-qos job submitted later must start first
@@ -308,6 +342,8 @@ def test_multifactor_priority_orders_cycle():
                       now=1.0)
     started = sched.schedule_cycle(now=2.0)
     assert started == [hi]
+    # the loser's node is busy NOW -> "Resource" (not "Priority";
+    # reference cpp:6797-6822 checks res_avail of the chosen nodes)
     assert sched.job_info(lo).pending_reason == PendingReason.RESOURCE
 
 
