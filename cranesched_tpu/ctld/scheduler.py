@@ -43,6 +43,7 @@ from cranesched_tpu.models.priority import (
     priority_order,
 )
 from cranesched_tpu.models.solver import (
+    COST_SCALE,
     REASON_CONSTRAINT,
     REASON_RESOURCE,
     ClusterState,
@@ -329,15 +330,20 @@ class JobScheduler:
         """Per-cycle node cost seeded from running jobs' remaining
         cpu-time (reference NodeRater, JobScheduler.h:499-516:
         cost = Σ (end - now) * cpu / cpu_total)."""
-        cost = np.zeros(total.shape[0], np.float32)
+        cost = np.zeros(total.shape[0], np.int64)
         for job in self.running.values():
             end = (job.start_time or now) + job.spec.time_limit
             remaining = max(end - now, 0.0)
             cpus = job.spec.res.cpu
             for n in job.node_ids:
                 cpu_total = max(float(total[n, DIM_CPU]) / CPU_SCALE, 1e-9)
-                cost[n] += np.float32(remaining * cpus / cpu_total)
-        return cost
+                # int32 fixed-point ledger units (models/solver.py
+                # COST_SCALE) so the seeded base keeps cost accumulation
+                # associative across all solver implementations
+                cost[n] += int(np.round(
+                    np.float32(remaining) * np.float32(cpus)
+                    * np.float32(COST_SCALE) / np.float32(cpu_total)))
+        return cost.astype(np.int32)
 
     def _timed_state(self, now, avail, total, alive, cost0):
         res = self.config.time_resolution

@@ -10,7 +10,7 @@ per-node requirement fits *right now*, allocate, and update node costs.
 Design (TPU-first, not a translation):
 
 * Cluster state is a dense SoA: ``avail[N, R]`` int32 resource vectors,
-  ``total[N, R]``, boolean masks, and a float32 ``cost[N]`` vector.  The
+  ``total[N, R]``, boolean masks, and an int32 ``cost[N]`` ledger.  The
   reference's cost-ordered ``std::set`` + per-node object scan becomes a
   masked top-k over the cost vector — one vectorized op instead of an
   O(nodes) pointer walk.
@@ -45,6 +45,30 @@ from flax import struct
 
 from cranesched_tpu.ops.resources import DIM_CPU
 
+# The node-cost ledger is int32 fixed point: unit = 1/COST_SCALE
+# cpu-seconds.  Integer addition is associative, so ANY grouping of cost
+# updates — sequential scan, blocked prefix sums, sharded scatters —
+# yields bit-identical ledgers (the property solve_blocked's parallel
+# reconstruction relies on), with none of float32's 2^24 exactness cliff.
+# Resolution: a 60 s / 1-cpu job on a 128-cpu node still contributes
+# round(60*16/128) = 8 units, so small placements keep moving nodes off
+# the cost frontier (load spreading preserved).  Headroom: a max job
+# (86400 s, full node) is 1.4M units; int32 holds >1500 of those per
+# node per cycle — beyond the reference's own per-node job cap (1000,
+# JobScheduler.h:269).
+COST_SCALE = 16
+COST_INF = jnp.int32(2**31 - 1)  # "infeasible" sentinel cost
+
+
+def quantized_dcost(time_limit, req_cpu, cpu_total_f32):
+    """int32 MinCpuTimeRatioFirst increment:
+    round(seconds * cpu/cpu_total * COST_SCALE)
+    (reference JobScheduler.h:40-54 uses double; we pin fixed point)."""
+    return jnp.round(time_limit.astype(jnp.float32)
+                     * req_cpu.astype(jnp.float32) * COST_SCALE
+                     / cpu_total_f32).astype(jnp.int32)
+
+
 # Pending-reason codes (subset of the reference's pending reasons,
 # docs/en/reference/pending_reason.md).
 REASON_NONE = 0  # placed
@@ -61,9 +85,11 @@ class ClusterState:
     avail:  int32[N, R]  free resources per node (resource-vector encoding)
     total:  int32[N, R]  total resources per node
     alive:  bool[N]      node is up and not drained
-    cost:   f32[N]       MinCpuTimeRatioFirst running cost per node
-                         (sum over allocations of duration * cpu/cpu_total;
-                         reference JobScheduler.h:40-54, NodeRater h:499-516)
+    cost:   int32[N]     MinCpuTimeRatioFirst running cost per node in
+                         1/COST_SCALE cpu-second units (sum over
+                         allocations of duration * cpu/cpu_total;
+                         reference JobScheduler.h:40-54, NodeRater
+                         h:499-516)
     """
 
     avail: jax.Array
@@ -125,9 +151,10 @@ def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
     total = jnp.asarray(total, jnp.int32)
     alive = jnp.asarray(alive, bool)
     if cost is None:
-        cost = jnp.zeros(avail.shape[0], jnp.float32)
-    return ClusterState(avail=avail, total=total, alive=alive,
-                        cost=jnp.asarray(cost, jnp.float32))
+        cost = jnp.zeros(avail.shape[0], jnp.int32)
+    # float inputs (ledger units) are rounded into the int32 ledger
+    cost = jnp.round(jnp.asarray(cost, jnp.float32)).astype(jnp.int32)
+    return ClusterState(avail=avail, total=total, alive=alive, cost=cost)
 
 
 def job_feasibility(avail, alive, part_mask, req):
@@ -172,10 +199,9 @@ def apply_placement(avail, cost, total, req, time_limit, scatter_idx,
 
     cpu_total = jnp.maximum(total[:, DIM_CPU], 1).astype(jnp.float32)
     safe = jnp.clip(scatter_idx, 0, local_n - 1)
-    dcost = (time_limit.astype(jnp.float32)
-             * req[DIM_CPU].astype(jnp.float32) / cpu_total[safe])
+    dcost = quantized_dcost(time_limit, req[DIM_CPU], cpu_total[safe])
     cost = cost.at[scatter_idx].add(
-        jnp.where(apply_mask, dcost, 0.0), mode="drop")
+        jnp.where(apply_mask, dcost, 0), mode="drop")
     return avail, cost
 
 
@@ -188,12 +214,12 @@ def _place_one(avail, cost, state_total, state_alive, req, node_num,
                             jnp.sum(eligible, dtype=jnp.int32))
 
     # "First node_num feasible nodes in ascending cost order": mask
-    # infeasible nodes to +inf and take the k smallest.  top_k on negated
-    # cost returns the k smallest; ties go to the lowest node index.
-    masked_cost = jnp.where(feasible, cost, jnp.inf)
+    # infeasible nodes to the sentinel and take the k smallest.  top_k on
+    # negated cost returns the k smallest; ties go to the lowest index.
+    masked_cost = jnp.where(feasible, cost, COST_INF)
     neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
     k_mask = jnp.arange(max_nodes) < node_num
-    sel = ok & k_mask & jnp.isfinite(neg_cost)
+    sel = ok & k_mask & (neg_cost > -COST_INF)
 
     avail, cost = apply_placement(avail, cost, state_total, req, time_limit,
                                   idx, sel)

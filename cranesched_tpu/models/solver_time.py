@@ -50,7 +50,11 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from cranesched_tpu.models.solver import apply_placement, decide_job
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    apply_placement,
+    decide_job,
+)
 
 # start_bucket value for jobs that could not be scheduled in the window
 NO_START = jnp.int32(2**30)
@@ -149,10 +153,10 @@ def make_timed_state(avail, total, alive, run_nodes, run_req,
     time_avail = avail[:, None, :] + jnp.cumsum(releases, axis=1)
 
     if cost is None:
-        cost = jnp.zeros(n, jnp.float32)
+        cost = jnp.zeros(n, jnp.int32)
+    cost = jnp.round(jnp.asarray(cost, jnp.float32)).astype(jnp.int32)
     return TimedClusterState(time_avail=time_avail, total=total,
-                             alive=jnp.asarray(alive, bool),
-                             cost=jnp.asarray(cost, jnp.float32))
+                             alive=jnp.asarray(alive, bool), cost=cost)
 
 
 def _place_one_timed(time_avail, cost, total, alive, req, node_num,
@@ -189,10 +193,10 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
 
     # node selection at s: cheapest node_num among ok[:, s]
     ok_at_s = ok[:, jnp.clip(s, 0, T - 1)]
-    masked_cost = jnp.where(ok_at_s & placed_ok, cost, jnp.inf)
+    masked_cost = jnp.where(ok_at_s & placed_ok, cost, COST_INF)
     neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
     k_mask = jnp.arange(max_nodes) < node_num
-    sel = placed_ok & k_mask & jnp.isfinite(neg_cost)
+    sel = placed_ok & k_mask & (neg_cost > -COST_INF)
 
     # write allocation/reservation into [s, s+d) of the chosen rows
     tmask = (starts[None, :] >= s) & (starts[None, :] < s + dur_b)  # [1,T]

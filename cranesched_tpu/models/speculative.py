@@ -1,0 +1,349 @@
+"""Fast exact greedy placement: candidate pre-selection + narrow scan.
+
+``solve_greedy`` (models/solver.py) is the semantics-defining scan — one
+job per step, each step doing O(N·R) feasibility + an O(N) top_k.  That
+sequential chain is inherent (every placement mutates availability; the
+same dependence keeps the reference's C++ loop at
+src/CraneCtld/JobScheduler.cpp:6743-6836 single-threaded).  This module
+keeps the chain but makes each link O(R_cand) instead of O(N), with the
+O(J·N) part hoisted into one embarrassingly-parallel pass:
+
+Phase 1 (parallel): for every job, the R cheapest entry-feasible nodes
+  (by the same (cost, index) order the solver uses) plus the (R+1)-th
+  cheapest as a *threshold pair*.  Availability only shrinks and costs
+  only grow during a cycle, so a node infeasible at entry can never be
+  chosen, and any node outside the candidate list keeps a cost pair at or
+  above the threshold forever.
+
+Phase 2 (sequential scan, G jobs unrolled per step): each job gathers its
+  R candidate rows from the live carry (avail, cost), re-evaluates
+  feasibility and cost pairs there, and picks its node_num best.  The
+  pick is PROVABLY identical to the full solver when either
+  - the entry-feasible set fit inside the candidate list (threshold
+    infinite: the sequential feasible set is a subset of candidates), or
+  - the worst chosen pair is still strictly below the threshold pair
+    (no outside node can beat any chosen one).
+  Otherwise the step falls back to the full-width selection on the live
+  state (lax.cond) — exactness always, narrow work almost always.
+
+Bit-identical outputs to solve_greedy are asserted in
+tests/test_speculative_parity.py, including adversarial tie pileups that
+maximize fallbacks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    ClusterState,
+    JobBatch,
+    Placements,
+    apply_placement,
+    decide_job,
+    job_feasibility,
+    quantized_dcost,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "block_size"))
+def solve_blocked(state: ClusterState, jobs: JobBatch, max_nodes: int = 1,
+                  block_size: int = 128
+                  ) -> tuple[Placements, ClusterState]:
+    """Block speculation with exact parallel validation — the high-
+    throughput greedy for the "spread" regime.
+
+    Observation: with the MinCpuTimeRatioFirst update, a placed node's
+    cost jumps by ~time_limit·cpu_ratio, typically far past the cost
+    frontier, so consecutive similar jobs take consecutive ranks of the
+    entry cost order.  Per block of B jobs:
+
+    1. PROPOSE (parallel): each job takes the nodes at positions
+       [g(p), g(p)+node_num) of its own entry-feasible cost order, where
+       g(p) is the prefix sum of gang sizes of earlier in-block jobs with
+       the same eligibility mask (same-mask detection via a random
+       projection of the mask; collisions only cost prediction quality).
+    2. VALIDATE (parallel, exact): reconstruct the sequential state each
+       job would see if all proposals before it were the true outcome —
+       an exclusive cumulative sum of per-job (req, dcost) scatters over
+       the block — and recompute the TRUE top-k selection there.  Cost
+       accumulation is associative (integer-valued dcost, see
+       apply_placement), so the reconstruction is bit-exact.
+    3. Accept the longest prefix whose proposals equal their true
+       selections (job 0 always matches: its reconstructed state IS the
+       block-entry state), apply the summed deltas, advance.
+
+    Bit-identical to ``solve_greedy``; sequential depth is ~J/B blocks of
+    large parallel ops instead of J small steps.  Worst case (adversarial
+    cost ties) degrades to one job per block — still exact.
+    """
+    max_nodes = min(max_nodes, state.num_nodes)
+    J = jobs.req.shape[0]
+    n = state.num_nodes
+    B = block_size
+    k_list = min(B * max_nodes + max_nodes, n)
+
+    def pad(x, value=0):
+        widths = [(0, B)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=value)
+
+    req_p = pad(jobs.req)
+    nn_p = pad(jobs.node_num)
+    tl_p = pad(jobs.time_limit)
+    pm_p = pad(jobs.part_mask)
+    v_p = pad(jobs.valid, value=False)
+    # deterministic random projection for same-mask grouping
+    proj = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+
+    def cand_one(avail, cost, req, pm):
+        eligible, feasible = job_feasibility(avail, state.alive, pm, req)
+        masked_cost = jnp.where(feasible, cost, COST_INF)
+        neg_cost, idx = jax.lax.top_k(-masked_cost, k_list)
+        usable = neg_cost > -COST_INF
+        return idx, jnp.sum(usable, dtype=jnp.int32)
+
+    def true_one(avail0, cost0, req, node_num, pm, valid, cum_r, cum_d):
+        avail_i = avail0 - cum_r
+        eligible, feasible = job_feasibility(avail_i, state.alive, pm, req)
+        masked_cost = jnp.where(feasible, cost0 + cum_d, COST_INF)
+        neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+        ok, reason = decide_job(valid, node_num, max_nodes,
+                                jnp.sum(feasible, dtype=jnp.int32),
+                                jnp.sum(eligible, dtype=jnp.int32))
+        k_mask = jnp.arange(max_nodes) < node_num
+        sel = ok & k_mask & (neg_cost > -COST_INF)
+        return ok, jnp.where(sel, idx, -1), reason
+
+    def body(carry):
+        avail, cost, ptr, placed_o, nodes_o, reason_o = carry
+        breq = jax.lax.dynamic_slice_in_dim(req_p, ptr, B)
+        bnn = jax.lax.dynamic_slice_in_dim(nn_p, ptr, B)
+        btl = jax.lax.dynamic_slice_in_dim(tl_p, ptr, B)
+        bpm = jax.lax.dynamic_slice_in_dim(pm_p, ptr, B)
+        bv = jax.lax.dynamic_slice_in_dim(v_p, ptr, B)
+
+        # --- propose ---
+        cand_idx, n_finite = jax.vmap(
+            cand_one, in_axes=(None, None, 0, 0))(avail, cost, breq, bpm)
+        h = bpm.astype(jnp.float32) @ proj                     # [B]
+        same = h[:, None] == h[None, :]
+        lower = jnp.tril(jnp.ones((B, B), bool), -1)
+        g = jnp.sum(jnp.where(same & lower, bnn[None, :], 0),
+                    axis=1)                                    # [B]
+        pos = g[:, None] + jnp.arange(max_nodes)[None, :]      # [B, K]
+        k_mask = jnp.arange(max_nodes)[None, :] < bnn[:, None]
+        prop_ok = (bv & (bnn > 0) & (bnn <= max_nodes)
+                   & (g + bnn <= n_finite))
+        prop_sel = prop_ok[:, None] & k_mask
+        prop_idx = jnp.take_along_axis(
+            cand_idx, jnp.clip(pos, 0, k_list - 1), axis=1)
+        prop_chosen = jnp.where(prop_sel, prop_idx, -1)
+
+        # --- reconstruct sequential states (exclusive prefix sums) ---
+        sc_idx = jnp.where(prop_sel, prop_idx, n)              # [B, K]
+        rows = jnp.arange(B)[:, None]
+        req_delta = jnp.zeros((B, n + 1, breq.shape[1]), jnp.int32)
+        req_delta = req_delta.at[rows, sc_idx].add(
+            jnp.where(prop_sel[:, :, None], breq[:, None, :], 0))
+        cpu_total = jnp.maximum(state.total[:, DIM_CPU], 1
+                                ).astype(jnp.float32)
+        dcost = quantized_dcost(
+            btl[:, None], breq[:, DIM_CPU, None],
+            cpu_total[jnp.clip(sc_idx, 0, n - 1)])             # [B, K]
+        dc_delta = jnp.zeros((B, n + 1), jnp.int32)
+        dc_delta = dc_delta.at[rows, sc_idx].add(
+            jnp.where(prop_sel, dcost, 0))
+        cum_req = jnp.cumsum(req_delta[:, :n], axis=0)         # inclusive
+        cum_dc = jnp.cumsum(dc_delta[:, :n], axis=0)
+        zero_r = jnp.zeros_like(cum_req[:1])
+        zero_d = jnp.zeros_like(cum_dc[:1])
+        cum_req_x = jnp.concatenate([zero_r, cum_req], axis=0)  # [B+1,...]
+        cum_dc_x = jnp.concatenate([zero_d, cum_dc], axis=0)
+
+        # --- validate (exact true selections) ---
+        ok_true, chosen_true, reason_true = jax.vmap(
+            true_one, in_axes=(None, None, 0, 0, 0, 0, 0, 0))(
+                avail, cost, breq, bnn, bpm, bv,
+                cum_req_x[:B], cum_dc_x[:B])
+        match = ((ok_true == prop_ok)
+                 & jnp.all(chosen_true == prop_chosen, axis=1))
+        n_acc = jnp.where(jnp.any(~match),
+                          jnp.argmax(~match).astype(jnp.int32),
+                          jnp.int32(B))
+        n_acc = jnp.maximum(n_acc, 1)  # job 0 always matches by design
+        acc = jnp.arange(B) < n_acc
+
+        # --- apply the accepted prefix in one shot ---
+        avail = avail - cum_req_x[n_acc]
+        cost = cost + cum_dc_x[n_acc]
+
+        cur_p = jax.lax.dynamic_slice_in_dim(placed_o, ptr, B)
+        cur_n = jax.lax.dynamic_slice_in_dim(nodes_o, ptr, B)
+        cur_r = jax.lax.dynamic_slice_in_dim(reason_o, ptr, B)
+        placed_o = jax.lax.dynamic_update_slice_in_dim(
+            placed_o, jnp.where(acc, ok_true, cur_p), ptr, axis=0)
+        nodes_o = jax.lax.dynamic_update_slice_in_dim(
+            nodes_o, jnp.where(acc[:, None], chosen_true, cur_n), ptr,
+            axis=0)
+        reason_o = jax.lax.dynamic_update_slice_in_dim(
+            reason_o, jnp.where(acc, reason_true, cur_r), ptr, axis=0)
+        return avail, cost, ptr + n_acc, placed_o, nodes_o, reason_o
+
+    init = (state.avail, state.cost, jnp.int32(0),
+            jnp.zeros(J + B, bool),
+            jnp.full((J + B, max_nodes), -1, jnp.int32),
+            jnp.zeros(J + B, jnp.int32))
+    avail, cost, _, placed_o, nodes_o, reason_o = jax.lax.while_loop(
+        lambda c: c[2] < J, body, init)
+
+    new_state = state.replace(avail=avail, cost=cost)
+    return (Placements(placed=placed_o[:J], nodes=nodes_o[:J],
+                       reason=reason_o[:J]), new_state)
+
+
+def _entry_candidates(avail, cost, alive, req, part_mask, r_cand: int):
+    """Top r_cand entry-feasible nodes by (cost, idx) + threshold pair."""
+    n = avail.shape[0]
+    eligible, feasible = job_feasibility(avail, alive, part_mask, req)
+    masked_cost = jnp.where(feasible, cost, COST_INF)
+    if r_cand >= n:
+        # every node is a candidate — no outside node can exist
+        neg_cost, idx = jax.lax.top_k(-masked_cost, n)
+        cand_cost = -neg_cost
+        cand = jnp.where(cand_cost < COST_INF, idx, n)
+        thr_cost, thr_idx = COST_INF, jnp.int32(n)
+    else:
+        neg_cost, idx = jax.lax.top_k(-masked_cost, r_cand + 1)
+        cand_cost = -neg_cost
+        cand = jnp.where(cand_cost < COST_INF, idx, n)
+        thr_cost, thr_idx = cand_cost[r_cand], cand[r_cand]
+        cand = cand[:r_cand]
+    return (cand, thr_cost, thr_idx,
+            jnp.sum(feasible, dtype=jnp.int32),
+            jnp.sum(eligible, dtype=jnp.int32))
+
+
+def _pair_less(c1, i1, c2, i2):
+    """(cost, idx) lexicographic strict less-than."""
+    return (c1 < c2) | ((c1 == c2) & (i1 < i2))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_nodes", "r_cand", "group"))
+def solve_speculative(state: ClusterState, jobs: JobBatch,
+                      max_nodes: int = 1, r_cand: int = 32,
+                      group: int = 8
+                      ) -> tuple[Placements, ClusterState]:
+    """Bit-identical to ``solve_greedy``; sequential work per job is
+    O(r_cand) instead of O(num_nodes) except for rare fallbacks."""
+    max_nodes = min(max_nodes, state.num_nodes)
+    # the candidate list must at least cover one full gang
+    r_cand = min(max(r_cand, max_nodes), state.num_nodes)
+    J = jobs.req.shape[0]
+    n = state.num_nodes
+
+    # ---- phase 1: per-job candidates at entry state (parallel) ----
+    cand, thr_cost, thr_idx, n_feas0, n_elig = jax.vmap(
+        _entry_candidates, in_axes=(None, None, None, 0, 0, None))(
+            state.avail, state.cost, state.alive, jobs.req, jobs.part_mask,
+            r_cand)
+
+    # ---- phase 2: narrow sequential scan, `group` jobs per step ----
+    G = group
+    pad = (-J) % G
+
+    def padj(x, value=0):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=value)
+
+    req_p = padj(jobs.req)
+    nn_p = padj(jobs.node_num)
+    tl_p = padj(jobs.time_limit)
+    pm_p = padj(jobs.part_mask)
+    v_p = padj(jobs.valid, value=False)
+    cand_p = padj(cand, value=n)
+    thrc_p = padj(thr_cost, value=COST_INF)
+    thri_p = padj(thr_idx, value=n)
+    nfe_p = padj(n_feas0)
+    nel_p = padj(n_elig)
+    num_groups = (J + pad) // G
+
+    def reshape_g(x):
+        return x.reshape((num_groups, G) + x.shape[1:])
+
+    xs = tuple(map(reshape_g, (req_p, nn_p, tl_p, pm_p, v_p, cand_p,
+                               thrc_p, thri_p, nfe_p, nel_p)))
+
+    def place_narrow(avail, cost, req, node_num, jcand, thrc, thri, valid):
+        """Selection among the candidate rows of the live state."""
+        safe = jnp.clip(jcand, 0, n - 1)
+        cavail = avail[safe]                                  # [R, dims]
+        vfeas = jnp.all(req[None, :] <= cavail, axis=-1) & (jcand < n)
+        vcost = jnp.where(vfeas, cost[safe], COST_INF)
+        # order candidates by (cost, idx): scale-free lexsort over R rows
+        order = jnp.lexsort((jcand, vcost))
+        sel_pos = order[:max_nodes]
+        sel_cost = vcost[sel_pos]
+        sel_idx = jcand[sel_pos]
+        k_mask = jnp.arange(max_nodes) < node_num
+        vcount = jnp.sum(vfeas, dtype=jnp.int32)
+        enough = vcount >= node_num
+        # worst chosen pair must beat the threshold pair, else an outside
+        # node might have crept below one of ours
+        kth = jnp.clip(node_num - 1, 0, max_nodes - 1)
+        worst_ok = _pair_less(sel_cost[kth], sel_idx[kth], thrc, thri)
+        conclusive = (thrc == COST_INF) | (enough & worst_ok & valid)
+        return vcount, sel_idx, sel_cost, conclusive
+
+    def place_full(avail, cost, alive, req, part_mask):
+        """Full-width selection on the live state (the fallback)."""
+        eligible, feasible = job_feasibility(avail, alive, part_mask, req)
+        masked_cost = jnp.where(feasible, cost, COST_INF)
+        neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+        return (jnp.sum(feasible, dtype=jnp.int32), idx, -neg_cost)
+
+    def step(carry, xg):
+        avail, cost = carry
+        (greq, gnn, gtl, gpm, gv, gcand, gthrc, gthri, gnf0, gnel) = xg
+        oks, chosens, reasons = [], [], []
+        for i in range(G):  # unrolled: amortizes per-step latency
+            req, node_num, tl = greq[i], gnn[i], gtl[i]
+            vcount, nidx, ncost, conclusive = place_narrow(
+                avail, cost, req, node_num, gcand[i], gthrc[i], gthri[i],
+                gv[i])
+
+            def narrow(_):
+                return vcount, nidx, ncost
+
+            def full(_):
+                return place_full(avail, cost, state.alive, req, gpm[i])
+
+            n_feas, idx, sel_cost = jax.lax.cond(conclusive, narrow, full,
+                                                 None)
+            ok, reason = decide_job(gv[i], node_num, max_nodes, n_feas,
+                                    gnel[i])
+            k_mask = jnp.arange(max_nodes) < node_num
+            sel = ok & k_mask & (sel_cost < COST_INF)
+            scatter_idx = jnp.where(sel & (idx < n), idx, n)
+            avail, cost = apply_placement(avail, cost, state.total, req,
+                                          tl, scatter_idx, sel)
+            oks.append(ok)
+            chosens.append(jnp.where(sel, idx, -1))
+            reasons.append(reason)
+        return (avail, cost), (jnp.stack(oks), jnp.stack(chosens),
+                               jnp.stack(reasons))
+
+    (avail, cost), (placed, nodes_out, reason_out) = jax.lax.scan(
+        step, (state.avail, state.cost), xs)
+
+    placed = placed.reshape(-1)[:J]
+    nodes_out = nodes_out.reshape(-1, max_nodes)[:J]
+    reason_out = reason_out.reshape(-1)[:J]
+    new_state = state.replace(avail=avail, cost=cost)
+    return (Placements(placed=placed, nodes=nodes_out,
+                       reason=reason_out), new_state)

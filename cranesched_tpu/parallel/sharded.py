@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cranesched_tpu.models.solver import (
+    COST_INF,
     ClusterState,
     JobBatch,
     Placements,
@@ -92,7 +93,7 @@ def _place_one_shard(avail, cost, total, alive, req, node_num, time_limit,
     # Local k cheapest feasible nodes.  top_k ties resolve to the lowest
     # local index, matching the single-device solver's tie order.
     k = min(max_nodes, local_n)
-    masked_cost = jnp.where(feasible, cost, jnp.inf)
+    masked_cost = jnp.where(feasible, cost, COST_INF)
     neg_cost, lidx = jax.lax.top_k(-masked_cost, k)
     cand_cost = -neg_cost
     cand_gidx = lidx + offset
@@ -108,7 +109,7 @@ def _place_one_shard(avail, cost, total, alive, req, node_num, time_limit,
     sel_gidx = all_gidx[order]
 
     k_mask = jnp.arange(max_nodes) < node_num
-    sel = ok & k_mask & jnp.isfinite(sel_cost)
+    sel = ok & k_mask & (sel_cost < COST_INF)
     chosen = jnp.where(sel, sel_gidx, -1)
 
     # Apply updates for winners this shard owns.  OOB sentinel + drop mode

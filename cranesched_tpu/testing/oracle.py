@@ -9,9 +9,10 @@ The reference's only unspecified behavior — cost-tie ordering inside the
 std::set<pair<double, NodeState*>> — is pinned to "lowest node index first",
 and the TPU solver pins the same.
 
-Uses float32 cost accumulation to match the device solver exactly (the
-reference uses double; cost magnitude ordering is what matters for parity,
-and both of OUR implementations must agree bit-for-bit).
+Uses the int32 fixed-point cost ledger (1/COST_SCALE cpu-second units,
+see models/solver.py) to match the device solver exactly (the reference
+uses double; cost magnitude ordering is what matters for parity, and both
+of OUR implementations must agree bit-for-bit).
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ from cranesched_tpu.models.solver import (
     REASON_NONE,
     REASON_RESOURCE,
 )
+from cranesched_tpu.models.solver import COST_SCALE
 from cranesched_tpu.ops.resources import DIM_CPU
 
 
@@ -33,7 +35,7 @@ def solve_greedy_oracle(avail, total, alive, cost, req, node_num,
     Returns (placed[J], nodes[J, max_nodes], reason[J], avail', cost').
     """
     avail = np.array(avail, dtype=np.int64)  # headroom; values fit int32
-    cost = np.array(cost, dtype=np.float32)
+    cost = np.round(np.asarray(cost)).astype(np.int64)
     total = np.asarray(total)
     alive = np.asarray(alive, bool)
 
@@ -60,15 +62,18 @@ def solve_greedy_oracle(avail, total, alive, cost, req, node_num,
                          else REASON_CONSTRAINT)
             continue
         # ascending cost, ties -> lowest index (stable sort over index order)
-        order = np.argsort(np.where(feasible, cost, np.inf), kind="stable")
+        order = np.argsort(np.where(feasible, cost, 2 ** 31 - 1),
+                           kind="stable")
         chosen = order[: node_num[j]]
         for n in chosen:
             avail[n] -= req[j]
             cpu_total = max(int(total[n, DIM_CPU]), 1)
-            cost[n] = np.float32(
-                cost[n]
-                + np.float32(time_limit[j])
-                * np.float32(req[j, DIM_CPU]) / np.float32(cpu_total))
+            # int32 fixed-point dcost, same float32 op order as
+            # quantized_dcost in models/solver.py
+            cost[n] += int(np.round(
+                np.float32(time_limit[j])
+                * np.float32(req[j, DIM_CPU]) * np.float32(COST_SCALE)
+                / np.float32(cpu_total)))
         placed[j] = True
         # cost order (ties -> lowest index), matching the solver's top_k
         nodes_out[j, : node_num[j]] = chosen

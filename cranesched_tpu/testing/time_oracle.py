@@ -16,6 +16,7 @@ from cranesched_tpu.models.solver import (
     REASON_NONE,
     REASON_RESOURCE,
 )
+from cranesched_tpu.models.solver import COST_SCALE
 from cranesched_tpu.models.solver_time import NO_START
 from cranesched_tpu.ops.resources import DIM_CPU
 
@@ -45,7 +46,7 @@ def solve_backfill_oracle(time_avail, total, alive, cost, req, node_num,
     Returns (placed[J], start[J], nodes[J, max_nodes], reason[J],
     time_avail', cost')."""
     ta = np.array(time_avail, np.int64)
-    cost = np.array(cost, np.float32)
+    cost = np.round(np.asarray(cost)).astype(np.int64)
     total = np.asarray(total)
     alive = np.asarray(alive, bool)
     N, T, R = ta.shape
@@ -87,16 +88,19 @@ def solve_backfill_oracle(time_avail, total, alive, cost, req, node_num,
                          else REASON_CONSTRAINT)
             continue
 
-        order = np.argsort(np.where(ok[:, s_found], cost, np.inf),
+        order = np.argsort(np.where(ok[:, s_found], cost, 2 ** 31 - 1),
                            kind="stable")
         chosen = order[: node_num[j]]
         e = min(s_found + d, T)
         for n in chosen:
             ta[n, s_found:e] -= req[j]
             cpu_total = max(int(total[n, DIM_CPU]), 1)
-            cost[n] = np.float32(
-                cost[n] + np.float32(time_limit[j])
-                * np.float32(req[j, DIM_CPU]) / np.float32(cpu_total))
+            # int32 fixed-point dcost, same float32 op order as
+            # quantized_dcost in models/solver.py
+            cost[n] += int(np.round(
+                np.float32(time_limit[j])
+                * np.float32(req[j, DIM_CPU]) * np.float32(COST_SCALE)
+                / np.float32(cpu_total)))
         placed[j] = True
         start[j] = s_found
         nodes_out[j, : node_num[j]] = chosen

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import pytest
 
 from cranesched_tpu.models.solver import (
-    ClusterState,
+    make_cluster_state,
     JobBatch,
     solve_greedy,
     REASON_NONE,
@@ -55,12 +55,10 @@ def random_problem(rng, n_jobs, n_nodes, n_parts=1, max_nodes=1,
 
 
 def run_both(state_d, jobs_d, max_nodes):
-    state = ClusterState(
-        avail=jnp.asarray(state_d["avail"]),
-        total=jnp.asarray(state_d["total"]),
-        alive=jnp.asarray(state_d["alive"]),
-        cost=jnp.asarray(state_d["cost"]),
-    )
+    # the canonical constructor rounds float costs into the int32 ledger,
+    # exactly as the oracle does
+    state = make_cluster_state(state_d["avail"], state_d["total"],
+                               state_d["alive"], state_d["cost"])
     jobs = JobBatch(
         req=jnp.asarray(jobs_d["req"]),
         node_num=jnp.asarray(jobs_d["node_num"]),

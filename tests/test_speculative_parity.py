@@ -1,0 +1,141 @@
+"""Speculative batched solver ≡ sequential greedy solver, bit for bit.
+
+The acceptance rule (prefix-disjoint chosen sets) is argued exact in
+models/speculative.py; these tests enforce it empirically across random
+workloads, adversarial tie pileups, and gang jobs."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cranesched_tpu.models.solver import (
+    JobBatch,
+    make_cluster_state,
+    solve_greedy,
+)
+from cranesched_tpu.models.speculative import (
+    solve_blocked,
+    solve_speculative,
+)
+from cranesched_tpu.ops.resources import ResourceLayout
+
+from test_sharded_parity import _assert_same, _random_problem
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("r_cand,group", [(4, 4), (32, 8)])
+def test_speculative_matches_greedy_random(seed, r_cand, group):
+    rng = np.random.default_rng(seed)
+    state, jobs = _random_problem(rng, num_jobs=100, num_nodes=40,
+                                  max_nodes=4)
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=4)
+    p_sp, s_sp = solve_speculative(state, jobs, max_nodes=4,
+                                   r_cand=r_cand, group=group)
+    _assert_same(p_ref, s_ref, p_sp, s_sp)
+
+
+def test_speculative_tie_pileup_all_same_node():
+    # all costs zero, all jobs want the same cheapest node: worst case —
+    # every block accepts exactly one job, results must still be exact
+    lay = ResourceLayout()
+    total = np.tile(lay.encode(cpu=64, is_capacity=True), (4, 1))
+    state = make_cluster_state(total.copy(), total, np.ones(4, bool),
+                               np.zeros(4, np.float32))
+    J = 20
+    jobs = JobBatch(
+        req=jnp.asarray(np.tile(lay.encode(cpu=1), (J, 1))),
+        node_num=jnp.ones(J, jnp.int32),
+        time_limit=jnp.zeros(J, jnp.int32),  # dcost = 0 -> ties persist
+        part_mask=jnp.ones((J, 4), bool),
+        valid=jnp.ones(J, bool))
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=1)
+    # tiny candidate list forces threshold checks/fallbacks
+    p_sp, s_sp = solve_speculative(state, jobs, max_nodes=1, r_cand=2,
+                                   group=4)
+    _assert_same(p_ref, s_ref, p_sp, s_sp)
+    # every job lands on node 0 (always cheapest, always fits)
+    assert set(np.asarray(p_sp.nodes).ravel()) == {0}
+
+
+def test_speculative_gangs_and_saturation():
+    lay = ResourceLayout()
+    total = np.tile(lay.encode(cpu=8, is_capacity=True), (6, 1))
+    state = make_cluster_state(total.copy(), total, np.ones(6, bool),
+                               np.arange(6, dtype=np.float32))
+    J = 12
+    jobs = JobBatch(
+        req=jnp.asarray(np.tile(lay.encode(cpu=8), (J, 1))),
+        node_num=jnp.asarray([2, 1, 3, 1, 2, 1] * 2, jnp.int32),
+        time_limit=jnp.full(J, 3600, jnp.int32),
+        part_mask=jnp.ones((J, 6), bool),
+        valid=jnp.ones(J, bool))
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=4)
+    p_sp, s_sp = solve_speculative(state, jobs, max_nodes=4, r_cand=3,
+                                   group=5)
+    _assert_same(p_ref, s_ref, p_sp, s_sp)
+    # cluster saturates: 6 nodes, first jobs eat 2+1+3 -> rest unplaced
+    assert int(np.asarray(p_sp.placed).sum()) == 3
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("block_size", [4, 32])
+def test_blocked_matches_greedy_random(seed, block_size):
+    rng = np.random.default_rng(seed)
+    state, jobs = _random_problem(rng, num_jobs=100, num_nodes=40,
+                                  max_nodes=4)
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=4)
+    p_bl, s_bl = solve_blocked(state, jobs, max_nodes=4,
+                               block_size=block_size)
+    _assert_same(p_ref, s_ref, p_bl, s_bl)
+
+
+def test_blocked_tie_pileup_worst_case():
+    # dcost = 0 keeps every job's argmin identical: rank-shifted proposals
+    # are all wrong, every block must degrade to 1 job — and still be exact
+    lay = ResourceLayout()
+    total = np.tile(lay.encode(cpu=64, is_capacity=True), (4, 1))
+    state = make_cluster_state(total.copy(), total, np.ones(4, bool),
+                               np.zeros(4, np.float32))
+    J = 20
+    jobs = JobBatch(
+        req=jnp.asarray(np.tile(lay.encode(cpu=1), (J, 1))),
+        node_num=jnp.ones(J, jnp.int32),
+        time_limit=jnp.zeros(J, jnp.int32),
+        part_mask=jnp.ones((J, 4), bool),
+        valid=jnp.ones(J, bool))
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=1)
+    p_bl, s_bl = solve_blocked(state, jobs, max_nodes=1, block_size=8)
+    _assert_same(p_ref, s_ref, p_bl, s_bl)
+    assert set(np.asarray(p_bl.nodes).ravel()) == {0}
+
+
+def test_blocked_spread_regime_and_partitions():
+    # distinct costs + large dcost: proposals should mostly validate;
+    # two partitions exercise the same-mask prefix offsets
+    lay = ResourceLayout()
+    rng = np.random.default_rng(3)
+    N, J = 32, 64
+    total = np.tile(lay.encode(cpu=64, is_capacity=True), (N, 1))
+    state = make_cluster_state(total.copy(), total, np.ones(N, bool),
+                               rng.random(N).astype(np.float32))
+    part = np.arange(N) % 2
+    jpart = rng.integers(0, 2, J)
+    jobs = JobBatch(
+        req=jnp.asarray(np.tile(lay.encode(cpu=4), (J, 1))),
+        node_num=jnp.asarray(rng.integers(1, 3, J), jnp.int32),
+        time_limit=jnp.full(J, 36000, jnp.int32),
+        part_mask=jnp.asarray(jpart[:, None] == part[None, :]),
+        valid=jnp.ones(J, bool))
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=2)
+    p_bl, s_bl = solve_blocked(state, jobs, max_nodes=2, block_size=16)
+    _assert_same(p_ref, s_ref, p_bl, s_bl)
+
+
+def test_speculative_group_bigger_than_batch():
+    rng = np.random.default_rng(7)
+    state, jobs = _random_problem(rng, num_jobs=10, num_nodes=16,
+                                  max_nodes=2)
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=2)
+    p_sp, s_sp = solve_speculative(state, jobs, max_nodes=2, r_cand=64,
+                                   group=64)
+    _assert_same(p_ref, s_ref, p_sp, s_sp)
