@@ -77,7 +77,15 @@ class JobSpec:
     partition: str = "default"
     res: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
     node_num: int = 1
-    ntasks_per_node: int = 1
+    # task packing (reference min_res_view = node res + task res * ntasks,
+    # JobScheduler.cpp:6152; get_max_tasks :6171): per-node requirement is
+    # ``res`` plus ``task_res`` per task.  Defaults collapse to the simple
+    # one-allocation-per-node shape.
+    task_res: ResourceSpec | None = None
+    ntasks: int | None = None         # total tasks; None = node_num
+    ntasks_per_node_min: int = 1
+    ntasks_per_node_max: int = 1
+    exclusive: bool = False           # whole idle nodes only (cpp:6248)
     time_limit: int = 3600            # seconds
     qos_priority: int = 0
     held: bool = False
@@ -111,7 +119,12 @@ class Job:
     end_time: float | None = None
     exit_code: int | None = None
     node_ids: list[int] = dataclasses.field(default_factory=list)
+    task_layout: list[int] = dataclasses.field(default_factory=list)
     requeue_count: int = 0
+    # cached per-node allocation vectors for the current incarnation
+    # (derived state — not persisted; cleared on requeue)
+    alloc_cache: list | None = dataclasses.field(
+        default=None, repr=False, compare=False)
     priority: float = 0.0
 
     def reset_for_requeue(self) -> None:
@@ -123,5 +136,7 @@ class Job:
         self.end_time = None
         self.exit_code = None
         self.node_ids = []
+        self.task_layout = []
+        self.alloc_cache = None
         self.requeue_count += 1
         self.priority = 0.0

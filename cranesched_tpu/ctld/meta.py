@@ -155,26 +155,39 @@ class MetaContainer:
 
     # ---- ledger (reference MallocResourceFromNode :126 / free) ----
 
+    @staticmethod
+    def _per_node(req, count: int) -> list[np.ndarray]:
+        """Normalize a single vector or a per-node list to a list."""
+        if isinstance(req, np.ndarray) and req.ndim == 1:
+            return [req] * count
+        return list(req)
+
     def malloc_resource(self, job_id: int, node_ids: Iterable[int],
-                        req: np.ndarray) -> bool:
-        """Atomically subtract ``req`` from every node or none (host
-        authoritative commit; the device solve already believed it fits)."""
+                        req) -> bool:
+        """Atomically subtract from every node or none (host authoritative
+        commit; the device solve already believed it fits).  ``req`` is a
+        single [R] vector or a per-node list (task packing / exclusive
+        allocations differ per node)."""
         node_ids = list(node_ids)
         nodes = [self.nodes[i] for i in node_ids]
-        if not all(n.schedulable and (req <= n.avail).all() for n in nodes):
+        reqs = self._per_node(req, len(nodes))
+        if not all(n.schedulable and (r <= n.avail).all()
+                   for n, r in zip(nodes, reqs)):
             return False
-        for n in nodes:
-            n.avail = n.avail - req
+        for n, r in zip(nodes, reqs):
+            n.avail = n.avail - r
             n.running_jobs.add(job_id)
         return True
 
     def free_resource(self, job_id: int, node_ids: Iterable[int],
-                      req: np.ndarray) -> None:
-        for i in node_ids:
+                      req) -> None:
+        node_ids = list(node_ids)
+        reqs = self._per_node(req, len(node_ids))
+        for i, r in zip(node_ids, reqs):
             node = self.nodes[i]
             if job_id in node.running_jobs:
                 node.running_jobs.discard(job_id)
-                node.avail = np.minimum(node.avail + req, node.total)
+                node.avail = np.minimum(node.avail + r, node.total)
 
     # ---- mid-cycle event capture (reference StartLogging /
     #      GetResReduceEvents, consumed at JobScheduler.cpp:1466-1540) ----

@@ -52,6 +52,7 @@ from cranesched_tpu.models.solver import (
     make_cluster_state,
     solve_greedy,
 )
+from cranesched_tpu.models.packing import PackedJobBatch, solve_packed
 from cranesched_tpu.models.solver_time import (
     TimedJobBatch,
     make_timed_state,
@@ -143,11 +144,17 @@ class JobScheduler:
         if not (1 <= spec.node_num
                 <= min(self.config.max_nodes_per_job, len(part.node_ids))):
             return 0
-        # CheckJobValidity analog: the per-node request must fit at least
-        # one node's *total* in the partition, else it can never run.
+        # CheckJobValidity analog: the per-node minimum request (base +
+        # task_res * min tasks, reference min_res_view cpp:6152) must fit
+        # at least one node's *total* in the partition.
         req = spec.res.encode(self.meta.layout)
+        if spec.task_res is not None:
+            req = req + (spec.task_res.encode(self.meta.layout)
+                         * spec.ntasks_per_node_min)
         if not (req <= self.meta.partition_max_total(spec.partition)).all():
             return 0
+        if spec.ntasks is not None and spec.ntasks < spec.node_num:
+            return 0  # every node must host at least one task
 
         job_id = self._next_job_id
         self._next_job_id += 1
@@ -244,9 +251,33 @@ class JobScheduler:
             return True
         return False
 
+    def _job_alloc(self, job: Job) -> list[np.ndarray]:
+        """Per-node allocation vectors (exclusive jobs own whole nodes;
+        packed jobs scale with their task layout).  Cached per incarnation
+        — this is on the per-cycle hot path via _initial_cost."""
+        if (job.alloc_cache is not None
+                and len(job.alloc_cache) == len(job.node_ids)):
+            return job.alloc_cache
+        spec = job.spec
+        if spec.exclusive:
+            alloc = [self.meta.nodes[n].total.copy()
+                     for n in job.node_ids]
+        else:
+            base = spec.res.encode(self.meta.layout)
+            if spec.task_res is None:
+                alloc = [base] * len(job.node_ids)
+            else:
+                task = spec.task_res.encode(self.meta.layout)
+                layout = (job.task_layout
+                          or [spec.ntasks_per_node_min]
+                          * len(job.node_ids))
+                alloc = [base + task * t for t in layout]
+        job.alloc_cache = alloc
+        return alloc
+
     def _release_job_resources(self, job: Job) -> None:
-        req = job.spec.res.encode(self.meta.layout)
-        self.meta.free_resource(job.job_id, job.node_ids, req)
+        self.meta.free_resource(job.job_id, job.node_ids,
+                                self._job_alloc(job))
 
     def _finalize(self, job: Job) -> None:
         self.history[job.job_id] = job
@@ -312,6 +343,21 @@ class JobScheduler:
         jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0])
         cost0 = self._initial_cost(now, total)
 
+        # cycles containing packed/exclusive jobs route to the
+        # full-fidelity packed solver (immediate-fit; such jobs don't get
+        # backfill reservations this round)
+        packed = any(j.spec.exclusive or j.spec.task_res is not None
+                     or (j.spec.ntasks is not None
+                         and j.spec.ntasks != j.spec.node_num)
+                     or j.spec.ntasks_per_node_max > 1 for j in ordered)
+        if packed:
+            state = make_cluster_state(avail, total, alive, cost0)
+            pbatch = self._packed_batch(jobs_batch, ordered)
+            placements, _ = solve_packed(state, pbatch,
+                                         max_nodes=max_nodes)
+            return self._commit(ordered, placements, now,
+                                tasks=np.asarray(placements.tasks))
+
         if self.config.backfill:
             state = self._timed_state(now, avail, total, alive, cost0)
             tbatch = self._timed_batch(jobs_batch, ordered)
@@ -334,8 +380,8 @@ class JobScheduler:
         for job in self.running.values():
             end = (job.start_time or now) + job.spec.time_limit
             remaining = max(end - now, 0.0)
-            cpus = job.spec.res.cpu
-            for n in job.node_ids:
+            for n, alloc in zip(job.node_ids, self._job_alloc(job)):
+                cpus = float(alloc[DIM_CPU]) / CPU_SCALE
                 cpu_total = max(float(total[n, DIM_CPU]) / CPU_SCALE, 1e-9)
                 # int32 fixed-point ledger units (models/solver.py
                 # COST_SCALE) so the seeded base keeps cost accumulation
@@ -348,21 +394,54 @@ class JobScheduler:
     def _timed_state(self, now, avail, total, alive, cost0):
         res = self.config.time_resolution
         T = self.config.time_buckets
-        r_jobs = list(self.running.values())
-        M = max(len(r_jobs), 1)
-        K = max((len(j.node_ids) for j in r_jobs), default=1)
-        run_nodes = np.full((M, K), -1, np.int32)
-        run_req = np.zeros((M, self.meta.layout.num_dims), np.int32)
-        run_end = np.full(M, T, np.int32)
-        for i, job in enumerate(r_jobs):
-            run_nodes[i, : len(job.node_ids)] = job.node_ids
-            run_req[i] = job.spec.res.encode(self.meta.layout)
+        # one release row per (job, node): packed/exclusive allocations
+        # differ per node, so each allocation releases its own amount
+        rows = []
+        for job in self.running.values():
             end = (job.start_time or now) + job.spec.time_limit
             # overdue jobs (end <= now) are about to be killed but still
             # hold resources: release no earlier than bucket 1
-            run_end[i] = max(int(np.ceil((end - now) / res)), 1)
+            eb = max(int(np.ceil((end - now) / res)), 1)
+            for n, alloc in zip(job.node_ids, self._job_alloc(job)):
+                rows.append((n, alloc, eb))
+        M = max(len(rows), 1)
+        run_nodes = np.full((M, 1), -1, np.int32)
+        run_req = np.zeros((M, self.meta.layout.num_dims), np.int32)
+        run_end = np.full(M, T, np.int32)
+        for i, (n, alloc, eb) in enumerate(rows):
+            run_nodes[i, 0] = n
+            run_req[i] = alloc
+            run_end[i] = eb
         return make_timed_state(avail, total, alive, run_nodes, run_req,
                                 run_end, T, cost0)
+
+    def _packed_batch(self, batch: JobBatch, ordered: list[Job]
+                      ) -> PackedJobBatch:
+        lay = self.meta.layout
+        J = batch.req.shape[0]
+        node_req = np.zeros((J, lay.num_dims), np.int32)
+        task_req = np.zeros((J, lay.num_dims), np.int32)
+        ntasks = np.ones(J, np.int32)
+        nt_min = np.ones(J, np.int32)
+        nt_max = np.ones(J, np.int32)
+        exclusive = np.zeros(J, bool)
+        for i, job in enumerate(ordered):
+            spec = job.spec
+            node_req[i] = spec.res.encode(lay)
+            if spec.task_res is not None:
+                task_req[i] = spec.task_res.encode(lay)
+            ntasks[i] = (spec.ntasks if spec.ntasks is not None
+                         else spec.node_num)
+            nt_min[i] = spec.ntasks_per_node_min
+            nt_max[i] = max(spec.ntasks_per_node_max,
+                            spec.ntasks_per_node_min)
+            exclusive[i] = spec.exclusive
+        return PackedJobBatch(
+            node_req=jnp.asarray(node_req), task_req=jnp.asarray(task_req),
+            ntasks=jnp.asarray(ntasks), ntasks_min=jnp.asarray(nt_min),
+            ntasks_max=jnp.asarray(nt_max), node_num=batch.node_num,
+            time_limit=batch.time_limit, part_mask=batch.part_mask,
+            exclusive=jnp.asarray(exclusive), valid=batch.valid)
 
     def _timed_batch(self, batch: JobBatch, ordered: list[Job]
                      ) -> TimedJobBatch:
@@ -519,7 +598,7 @@ class JobScheduler:
         return batch, max_nodes
 
     def _commit(self, ordered: list[Job], placements: Placements,
-                now: float, start_buckets=None) -> list[int]:
+                now: float, start_buckets=None, tasks=None) -> list[int]:
         """Host authoritative commit + dispatch (cpp:1557-1839): re-check
         against the live ledger and the cycle's reduce events; jobs whose
         nodes died mid-cycle simply stay pending for the next cycle.
@@ -557,14 +636,21 @@ class JobScheduler:
             if dirty_nodes.intersection(node_ids):
                 job.pending_reason = PendingReason.RESOURCE
                 continue
-            req = job.spec.res.encode(self.meta.layout)
-            if not self.meta.malloc_resource(job.job_id, node_ids, req):
+            job.node_ids = node_ids
+            job.task_layout = ([int(t) for t, n in
+                                zip(tasks[i], nodes_mat[i]) if n >= 0]
+                               if tasks is not None else [])
+            if not self.meta.malloc_resource(job.job_id, node_ids,
+                                             self._job_alloc(job)):
+                job.node_ids = []
+                job.task_layout = []
+                job.alloc_cache = None  # never reuse a failed placement's
+                                        # per-node amounts
                 job.pending_reason = PendingReason.RESOURCE
                 continue
             del self.pending[job.job_id]
             job.status = JobStatus.RUNNING
             job.start_time = now
-            job.node_ids = node_ids
             job.pending_reason = PendingReason.NONE
             self.running[job.job_id] = job
             if self.wal is not None:
@@ -594,8 +680,8 @@ class JobScheduler:
             if job.status.is_terminal:
                 self.history[job_id] = job
             elif job.status == JobStatus.RUNNING:
-                req = job.spec.res.encode(self.meta.layout)
-                if self.meta.malloc_resource(job_id, job.node_ids, req):
+                if self.meta.malloc_resource(job_id, job.node_ids,
+                                             self._job_alloc(job)):
                     self.running[job_id] = job
                     if job.cancel_requested:
                         # the kill may have been lost with the crash;

@@ -24,27 +24,43 @@ from typing import IO
 from cranesched_tpu.ctld.defs import Job, JobSpec, JobStatus, PendingReason, ResourceSpec
 
 
-def _spec_to_dict(spec: JobSpec) -> dict:
-    d = dataclasses.asdict(spec)
-    res = d.pop("res")
+def _res_to_dict(res: dict) -> dict:
     gres = res.pop("gres")
     res["gres"] = ([[list(k), v] for k, v in gres.items()]
                    if gres else None)
-    d["res"] = res
+    return res
+
+
+def _spec_to_dict(spec: JobSpec) -> dict:
+    d = dataclasses.asdict(spec)
+    d["res"] = _res_to_dict(d.pop("res"))
+    task_res = d.pop("task_res")
+    d["task_res"] = _res_to_dict(task_res) if task_res else None
     d["include_nodes"] = list(spec.include_nodes)
     d["exclude_nodes"] = list(spec.exclude_nodes)
     return d
 
 
-def _spec_from_dict(d: dict) -> JobSpec:
-    d = dict(d)
-    res = dict(d.pop("res"))
+def _res_from_dict(res: dict) -> ResourceSpec:
+    res = dict(res)
     gres = res.pop("gres")
     res["gres"] = ({tuple(k): v for k, v in gres} if gres else None)
-    d["res"] = ResourceSpec(**res)
+    return ResourceSpec(**res)
+
+
+_SPEC_FIELDS = {f.name for f in dataclasses.fields(JobSpec)}
+
+
+def _spec_from_dict(d: dict) -> JobSpec:
+    d = dict(d)
+    d["res"] = _res_from_dict(d.pop("res"))
+    task_res = d.pop("task_res", None)
+    d["task_res"] = _res_from_dict(task_res) if task_res else None
     d["include_nodes"] = tuple(d.get("include_nodes") or ())
     d["exclude_nodes"] = tuple(d.get("exclude_nodes") or ())
-    return JobSpec(**d)
+    # forward compatibility: records written by older versions may carry
+    # fields the current JobSpec no longer has — drop, don't crash
+    return JobSpec(**{k: v for k, v in d.items() if k in _SPEC_FIELDS})
 
 
 def _job_to_dict(job: Job) -> dict:
@@ -60,6 +76,7 @@ def _job_to_dict(job: Job) -> dict:
         "end_time": job.end_time,
         "exit_code": job.exit_code,
         "node_ids": job.node_ids,
+        "task_layout": job.task_layout,
         "requeue_count": job.requeue_count,
     }
 
@@ -77,6 +94,7 @@ def _job_from_dict(d: dict) -> Job:
         end_time=d["end_time"],
         exit_code=d["exit_code"],
         node_ids=list(d["node_ids"]),
+        task_layout=list(d.get("task_layout") or ()),
         requeue_count=d["requeue_count"],
     )
 

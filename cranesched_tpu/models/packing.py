@@ -1,0 +1,187 @@
+"""Full-fidelity placement: multi-task packing and exclusive nodes.
+
+Covers the reference's task-packing surface in GetNodesAndTrySchedule_
+(reference: src/CraneCtld/JobScheduler.cpp:6148-6369):
+
+* a job asks for ``ntasks`` tasks over ``node_num`` nodes, each node
+  hosting between ``ntasks_per_node_min`` and ``ntasks_per_node_max``;
+  a node's requirement is ``node_req + task_req * tasks_on_node``
+  (``min_res_view`` at cpp:6152-6154);
+* per-node capacity is the get_max_tasks loop (cpp:6171-6186): fit the
+  minimum, then admit one task at a time while ``task_req`` still fits —
+  here one ``fit_count`` (the reference's ResourceView division,
+  PublicHeader.h:769) instead of a loop;
+* ``exclusive`` jobs need completely idle nodes and consume them whole
+  (cpp:6248-6262);
+* tasks distribute over the chosen gang smallest-capacity-first, each
+  node taking ``min(rest, cap-1) + 1`` (cpp:6305-6344).
+
+Pinned divergence (documented, conservative): the reference scans nodes
+in cost order but KEEPS the gang with the largest capacities from the
+scanned prefix (a bounded priority queue, cpp:6233-6246); we take the
+``node_num`` CHEAPEST capacity-positive nodes and fail the job if their
+combined capacity misses ``ntasks``.  Ours never picks a more expensive
+node when a cheaper one can host; the reference can occasionally place a
+job ours defers to the next cycle.  The distribution tie order (equal
+capacities) is pinned to lowest-node-index-first; the reference's heap
+order for ties is unspecified.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    REASON_RESOURCE,
+    ClusterState,
+    cheapest_k,
+    decide_job,
+    quantized_dcost,
+)
+from cranesched_tpu.ops.resources import DIM_CPU, fit_count
+
+
+@struct.dataclass
+class PackedJobBatch:
+    """Priority-ordered pending jobs with the full request shape.
+
+    node_req:   int32[J, R] per-node base requirement
+    task_req:   int32[J, R] per-task requirement
+    ntasks:     int32[J]    total tasks across the gang
+    ntasks_min: int32[J]    min tasks per node
+    ntasks_max: int32[J]    max tasks per node
+    node_num:   int32[J]
+    time_limit: int32[J]
+    part_mask:  bool[J, N]
+    exclusive:  bool[J]
+    valid:      bool[J]
+    """
+
+    node_req: jax.Array
+    task_req: jax.Array
+    ntasks: jax.Array
+    ntasks_min: jax.Array
+    ntasks_max: jax.Array
+    node_num: jax.Array
+    time_limit: jax.Array
+    part_mask: jax.Array
+    exclusive: jax.Array
+    valid: jax.Array
+
+
+@struct.dataclass
+class PackedPlacements:
+    """placed/nodes/reason as Placements, plus the task layout:
+    tasks[J, K] — tasks assigned to nodes[J, K] (0 where unused)."""
+
+    placed: jax.Array
+    nodes: jax.Array
+    tasks: jax.Array
+    reason: jax.Array
+
+
+def _node_capacity(avail, total, node_req, task_req, ntasks_min,
+                   ntasks_max, exclusive):
+    """Max tasks each node could host (get_max_tasks, cpp:6171-6186).
+    Exclusive jobs size capacity from the node's TOTAL resources."""
+    base = jnp.where(exclusive, total, avail)
+    min_req = node_req + task_req * ntasks_min
+    fits_min = jnp.all(min_req[None, :] <= base, axis=-1)
+    headroom = jnp.maximum(base - min_req[None, :], 0)
+    extra = fit_count(headroom, task_req[None, :])
+    cap = jnp.clip(ntasks_min + extra, 0, ntasks_max)
+    return jnp.where(fits_min, cap, 0)
+
+
+def _place_one_packed(avail, cost, total, alive, job, max_nodes: int):
+    (node_req, task_req, ntasks, nt_min, nt_max, node_num, time_limit,
+     part_mask, exclusive, valid) = job
+    n = avail.shape[0]
+
+    eligible = alive & part_mask
+    free_full = jnp.all(avail == total, axis=-1)
+    cap = _node_capacity(avail, total, node_req, task_req, nt_min, nt_max,
+                         exclusive)
+    feasible = eligible & (cap > 0) & jnp.where(exclusive, free_full, True)
+
+    num_feasible = jnp.sum(feasible, dtype=jnp.int32)
+    ok, reason = decide_job(valid, node_num, max_nodes, num_feasible,
+                            jnp.sum(eligible, dtype=jnp.int32))
+
+    # the node_num cheapest feasible nodes
+    masked_cost = jnp.where(feasible, cost, COST_INF)
+    sel_cost, idx = cheapest_k(masked_cost, max_nodes)
+    k_mask = jnp.arange(max_nodes) < node_num
+    sel = ok & k_mask & (sel_cost < COST_INF)
+
+    # combined capacity must cover ntasks (and every node hosts >= 1)
+    cap_sel = jnp.where(sel, cap[idx], 0)
+    cap_ok = (jnp.sum(cap_sel) >= ntasks) & (ntasks >= node_num)
+    reason = jnp.where(ok & ~cap_ok, REASON_RESOURCE, reason)
+    ok = ok & cap_ok
+    sel = sel & ok
+
+    # distribute tasks smallest-capacity-first (cpp:6305-6344), ties to
+    # the lowest node index; unused slots sort last
+    dist_key = jnp.where(sel, cap_sel, jnp.int32(2**30))
+    order = jnp.lexsort((jnp.where(sel, idx, n), dist_key))
+    rest = jnp.maximum(ntasks - node_num, 0)
+    tasks_sorted = jnp.zeros(max_nodes, jnp.int32)
+    for i in range(max_nodes):  # static unroll, max_nodes is small
+        c = dist_key[order[i]]
+        t = jnp.minimum(rest, jnp.maximum(c - 1, 0)) + 1
+        t = jnp.where(sel[order[i]], t, 0)
+        tasks_sorted = tasks_sorted.at[i].set(t)
+        rest = rest - jnp.maximum(t - 1, 0)
+    # un-sort back to selection order
+    tasks = jnp.zeros(max_nodes, jnp.int32).at[order].set(tasks_sorted)
+
+    # per-node allocation: whole node when exclusive, else base+tasks*task
+    alloc = jnp.where(
+        exclusive,
+        total[jnp.clip(idx, 0, n - 1)],
+        node_req[None, :] + task_req[None, :] * tasks[:, None])
+    delta = jnp.where(sel[:, None], alloc, 0)
+    scatter_idx = jnp.where(sel, idx, n)
+    avail = avail.at[scatter_idx].add(-delta, mode="drop")
+
+    cpu_total = jnp.maximum(total[:, DIM_CPU], 1).astype(jnp.float32)
+    dcost = quantized_dcost(
+        jnp.broadcast_to(time_limit, (max_nodes,)), alloc[:, DIM_CPU],
+        cpu_total[jnp.clip(scatter_idx, 0, n - 1)])
+    cost = cost.at[scatter_idx].add(jnp.where(sel, dcost, 0), mode="drop")
+
+    chosen = jnp.where(sel, idx, -1)
+    return avail, cost, ok, chosen, tasks, reason
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def solve_packed(state: ClusterState, jobs: PackedJobBatch,
+                 max_nodes: int = 1
+                 ) -> tuple[PackedPlacements, ClusterState]:
+    """Greedy in-priority-order placement with task packing + exclusive
+    nodes.  Same scan structure as solve_greedy; a batch whose jobs all
+    have ntasks == node_num, task_req == 0 and exclusive == False reduces
+    to exactly solve_greedy's behavior."""
+    max_nodes = min(max_nodes, state.num_nodes)
+
+    def step(carry, job):
+        avail, cost = carry
+        avail, cost, ok, chosen, tasks, reason = _place_one_packed(
+            avail, cost, state.total, state.alive, job, max_nodes)
+        return (avail, cost), (ok, chosen, tasks, reason)
+
+    (avail, cost), (placed, nodes, tasks, reason) = jax.lax.scan(
+        step, (state.avail, state.cost),
+        (jobs.node_req, jobs.task_req, jobs.ntasks, jobs.ntasks_min,
+         jobs.ntasks_max, jobs.node_num, jobs.time_limit, jobs.part_mask,
+         jobs.exclusive, jobs.valid))
+
+    new_state = state.replace(avail=avail, cost=cost)
+    return (PackedPlacements(placed=placed, nodes=nodes, tasks=tasks,
+                             reason=reason), new_state)

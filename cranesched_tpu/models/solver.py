@@ -69,6 +69,25 @@ def quantized_dcost(time_limit, req_cpu, cpu_total_f32):
                      / cpu_total_f32).astype(jnp.int32)
 
 
+def cheapest_k(masked_cost, k: int):
+    """The k smallest entries of an int32 cost vector, ascending, ties to
+    the lowest index.  Returns (values, indices).
+
+    Replaces ``lax.top_k(-cost, k)``: XLA's int32 top_k lowers to a path
+    ~100× slower than float32 on CPU (measured), while argmin on int32 is
+    fast — so for the small k of a placement step, k iterative argmins
+    (masking each winner to the sentinel) win by a wide margin and keep
+    identical tie semantics (argmin returns the first occurrence)."""
+    vals, idxs = [], []
+    c = masked_cost
+    for _ in range(k):
+        i = jnp.argmin(c)
+        vals.append(c[i])
+        idxs.append(i.astype(jnp.int32))
+        c = c.at[i].set(COST_INF)
+    return jnp.stack(vals), jnp.stack(idxs)
+
+
 # Pending-reason codes (subset of the reference's pending reasons,
 # docs/en/reference/pending_reason.md).
 REASON_NONE = 0  # placed
@@ -152,8 +171,13 @@ def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
     alive = jnp.asarray(alive, bool)
     if cost is None:
         cost = jnp.zeros(avail.shape[0], jnp.int32)
-    # float inputs (ledger units) are rounded into the int32 ledger
-    cost = jnp.round(jnp.asarray(cost, jnp.float32)).astype(jnp.int32)
+    # float inputs (ledger units) round into the int32 ledger; integer
+    # inputs must NOT round-trip through float32 (would reintroduce the
+    # 2^24 exactness cliff for large seeded costs)
+    cost = jnp.asarray(cost)
+    if jnp.issubdtype(cost.dtype, jnp.floating):
+        cost = jnp.round(cost.astype(jnp.float32))
+    cost = cost.astype(jnp.int32)
     return ClusterState(avail=avail, total=total, alive=alive, cost=cost)
 
 
@@ -214,12 +238,12 @@ def _place_one(avail, cost, state_total, state_alive, req, node_num,
                             jnp.sum(eligible, dtype=jnp.int32))
 
     # "First node_num feasible nodes in ascending cost order": mask
-    # infeasible nodes to the sentinel and take the k smallest.  top_k on
-    # negated cost returns the k smallest; ties go to the lowest index.
+    # infeasible nodes to the sentinel and take the k smallest; ties go
+    # to the lowest index.
     masked_cost = jnp.where(feasible, cost, COST_INF)
-    neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+    sel_cost, idx = cheapest_k(masked_cost, max_nodes)
     k_mask = jnp.arange(max_nodes) < node_num
-    sel = ok & k_mask & (neg_cost > -COST_INF)
+    sel = ok & k_mask & (sel_cost < COST_INF)
 
     avail, cost = apply_placement(avail, cost, state_total, req, time_limit,
                                   idx, sel)

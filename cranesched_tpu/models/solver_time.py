@@ -53,6 +53,7 @@ from flax import struct
 from cranesched_tpu.models.solver import (
     COST_INF,
     apply_placement,
+    cheapest_k,
     decide_job,
 )
 
@@ -154,7 +155,10 @@ def make_timed_state(avail, total, alive, run_nodes, run_req,
 
     if cost is None:
         cost = jnp.zeros(n, jnp.int32)
-    cost = jnp.round(jnp.asarray(cost, jnp.float32)).astype(jnp.int32)
+    cost = jnp.asarray(cost)
+    if jnp.issubdtype(cost.dtype, jnp.floating):
+        cost = jnp.round(cost.astype(jnp.float32))
+    cost = cost.astype(jnp.int32)
     return TimedClusterState(time_avail=time_avail, total=total,
                              alive=jnp.asarray(alive, bool), cost=cost)
 
@@ -194,9 +198,9 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
     # node selection at s: cheapest node_num among ok[:, s]
     ok_at_s = ok[:, jnp.clip(s, 0, T - 1)]
     masked_cost = jnp.where(ok_at_s & placed_ok, cost, COST_INF)
-    neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+    sel_cost, idx = cheapest_k(masked_cost, max_nodes)
     k_mask = jnp.arange(max_nodes) < node_num
-    sel = placed_ok & k_mask & (neg_cost > -COST_INF)
+    sel = placed_ok & k_mask & (sel_cost < COST_INF)
 
     # write allocation/reservation into [s, s+d) of the chosen rows
     tmask = (starts[None, :] >= s) & (starts[None, :] < s + dur_b)  # [1,T]

@@ -44,6 +44,7 @@ from cranesched_tpu.models.solver import (
     JobBatch,
     Placements,
     apply_placement,
+    cheapest_k,
     decide_job,
     job_feasibility,
     quantized_dcost,
@@ -103,20 +104,22 @@ def solve_blocked(state: ClusterState, jobs: JobBatch, max_nodes: int = 1,
     def cand_one(avail, cost, req, pm):
         eligible, feasible = job_feasibility(avail, state.alive, pm, req)
         masked_cost = jnp.where(feasible, cost, COST_INF)
-        neg_cost, idx = jax.lax.top_k(-masked_cost, k_list)
-        usable = neg_cost > -COST_INF
-        return idx, jnp.sum(usable, dtype=jnp.int32)
+        # stable argsort (ascending, ties to lowest index) — int32 top_k
+        # lowers to a very slow CPU path, argsort does not
+        idx = jnp.argsort(masked_cost, stable=True)[:k_list]
+        usable = masked_cost[idx] < COST_INF
+        return idx.astype(jnp.int32), jnp.sum(usable, dtype=jnp.int32)
 
     def true_one(avail0, cost0, req, node_num, pm, valid, cum_r, cum_d):
         avail_i = avail0 - cum_r
         eligible, feasible = job_feasibility(avail_i, state.alive, pm, req)
         masked_cost = jnp.where(feasible, cost0 + cum_d, COST_INF)
-        neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
+        sel_cost, idx = cheapest_k(masked_cost, max_nodes)
         ok, reason = decide_job(valid, node_num, max_nodes,
                                 jnp.sum(feasible, dtype=jnp.int32),
                                 jnp.sum(eligible, dtype=jnp.int32))
         k_mask = jnp.arange(max_nodes) < node_num
-        sel = ok & k_mask & (neg_cost > -COST_INF)
+        sel = ok & k_mask & (sel_cost < COST_INF)
         return ok, jnp.where(sel, idx, -1), reason
 
     def body(carry):
@@ -211,16 +214,16 @@ def _entry_candidates(avail, cost, alive, req, part_mask, r_cand: int):
     n = avail.shape[0]
     eligible, feasible = job_feasibility(avail, alive, part_mask, req)
     masked_cost = jnp.where(feasible, cost, COST_INF)
+    order = jnp.argsort(masked_cost, stable=True)
     if r_cand >= n:
         # every node is a candidate — no outside node can exist
-        neg_cost, idx = jax.lax.top_k(-masked_cost, n)
-        cand_cost = -neg_cost
-        cand = jnp.where(cand_cost < COST_INF, idx, n)
+        cand_cost = masked_cost[order]
+        cand = jnp.where(cand_cost < COST_INF, order, n).astype(jnp.int32)
         thr_cost, thr_idx = COST_INF, jnp.int32(n)
     else:
-        neg_cost, idx = jax.lax.top_k(-masked_cost, r_cand + 1)
-        cand_cost = -neg_cost
-        cand = jnp.where(cand_cost < COST_INF, idx, n)
+        idx = order[: r_cand + 1]
+        cand_cost = masked_cost[idx]
+        cand = jnp.where(cand_cost < COST_INF, idx, n).astype(jnp.int32)
         thr_cost, thr_idx = cand_cost[r_cand], cand[r_cand]
         cand = cand[:r_cand]
     return (cand, thr_cost, thr_idx,
@@ -304,8 +307,8 @@ def solve_speculative(state: ClusterState, jobs: JobBatch,
         """Full-width selection on the live state (the fallback)."""
         eligible, feasible = job_feasibility(avail, alive, part_mask, req)
         masked_cost = jnp.where(feasible, cost, COST_INF)
-        neg_cost, idx = jax.lax.top_k(-masked_cost, max_nodes)
-        return (jnp.sum(feasible, dtype=jnp.int32), idx, -neg_cost)
+        sel_cost, idx = cheapest_k(masked_cost, max_nodes)
+        return (jnp.sum(feasible, dtype=jnp.int32), idx, sel_cost)
 
     def step(carry, xg):
         avail, cost = carry

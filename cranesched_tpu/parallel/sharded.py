@@ -44,6 +44,7 @@ from cranesched_tpu.models.solver import (
     JobBatch,
     Placements,
     apply_placement,
+    cheapest_k,
     decide_job,
     job_feasibility,
 )
@@ -94,8 +95,7 @@ def _place_one_shard(avail, cost, total, alive, req, node_num, time_limit,
     # local index, matching the single-device solver's tie order.
     k = min(max_nodes, local_n)
     masked_cost = jnp.where(feasible, cost, COST_INF)
-    neg_cost, lidx = jax.lax.top_k(-masked_cost, k)
-    cand_cost = -neg_cost
+    cand_cost, lidx = cheapest_k(masked_cost, k)
     cand_gidx = lidx + offset
 
     # Merge candidates across shards (ICI all_gather), then select the

@@ -1,0 +1,105 @@
+"""NumPy oracle for the packed solve (task packing + exclusive nodes).
+
+Loop transcription of the semantics pinned in models/packing.py (which
+mirrors reference get_max_tasks cpp:6171-6186, exclusive cpp:6248-6262,
+and the smallest-capacity-first task distribution cpp:6305-6344, with the
+documented cheapest-gang divergence)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cranesched_tpu.models.solver import (
+    COST_SCALE,
+    REASON_CONSTRAINT,
+    REASON_NONE,
+    REASON_RESOURCE,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+BIG = 2 ** 30
+
+
+def _capacity(base, node_req, task_req, nt_min, nt_max):
+    min_req = node_req + task_req * nt_min
+    if not np.all(min_req <= base):
+        return 0
+    headroom = base - min_req
+    cap = nt_min
+    while cap < nt_max:
+        if np.all(task_req <= headroom):
+            headroom = headroom - task_req
+            cap += 1
+        else:
+            break
+    return int(cap)
+
+
+def solve_packed_oracle(avail, total, alive, cost, jobs, max_nodes):
+    """jobs: list of dicts with node_req/task_req/ntasks/ntasks_min/
+    ntasks_max/node_num/time_limit/part_mask/exclusive/valid.
+    Returns (placed, nodes, tasks, reason, avail', cost')."""
+    avail = np.array(avail, np.int64)
+    total = np.asarray(total)
+    cost = np.round(np.asarray(cost)).astype(np.int64)
+    alive = np.asarray(alive, bool)
+    N = avail.shape[0]
+    J = len(jobs)
+    placed = np.zeros(J, bool)
+    nodes_out = np.full((J, max_nodes), -1, np.int32)
+    tasks_out = np.zeros((J, max_nodes), np.int32)
+    reason = np.zeros(J, np.int32)
+
+    for j, job in enumerate(jobs):
+        eligible = alive & np.asarray(job["part_mask"], bool)
+        nn = int(job["node_num"])
+        if not job["valid"] or nn <= 0 or nn > max_nodes:
+            bad = (not job["valid"]) or nn <= 0
+            reason[j] = (REASON_CONSTRAINT
+                         if bad or eligible.sum() < nn
+                         else REASON_RESOURCE)
+            continue
+        cap = np.zeros(N, np.int64)
+        feasible = np.zeros(N, bool)
+        for n in range(N):
+            if not eligible[n]:
+                continue
+            base = total[n] if job["exclusive"] else avail[n]
+            c = _capacity(base, job["node_req"], job["task_req"],
+                          int(job["ntasks_min"]), int(job["ntasks_max"]))
+            cap[n] = c
+            feasible[n] = c > 0 and (
+                np.all(avail[n] == total[n]) if job["exclusive"] else True)
+        if feasible.sum() < nn:
+            reason[j] = (REASON_RESOURCE if eligible.sum() >= nn
+                         else REASON_CONSTRAINT)
+            continue
+        order = np.argsort(np.where(feasible, cost, BIG), kind="stable")
+        chosen = order[:nn]
+        if cap[chosen].sum() < job["ntasks"] or job["ntasks"] < nn:
+            reason[j] = REASON_RESOURCE
+            continue
+
+        # distribute smallest-capacity-first, ties -> lowest node index
+        dist = sorted(chosen, key=lambda n: (cap[n], n))
+        rest = int(job["ntasks"]) - nn
+        tasks = {}
+        for n in dist:
+            t = min(rest, int(cap[n]) - 1) + 1
+            tasks[n] = t
+            rest -= t - 1
+        for k, n in enumerate(chosen):
+            alloc = (total[n] if job["exclusive"]
+                     else job["node_req"] + job["task_req"] * tasks[n])
+            avail[n] -= alloc
+            cpu_total = max(int(total[n, DIM_CPU]), 1)
+            cost[n] += int(np.round(
+                np.float32(job["time_limit"])
+                * np.float32(alloc[DIM_CPU]) * np.float32(COST_SCALE)
+                / np.float32(cpu_total)))
+            nodes_out[j, k] = n
+            tasks_out[j, k] = tasks[n]
+        placed[j] = True
+        reason[j] = REASON_NONE
+
+    return placed, nodes_out, tasks_out, reason, avail, cost

@@ -333,6 +333,46 @@ def test_backfill_short_job_runs_despite_blocked_high_priority():
                for j in sched.history.values())
 
 
+def test_packed_job_lifecycle_and_ledger():
+    # 6 tasks x 2 cpu over 2 nodes + per-node base 1 cpu; the ledger must
+    # subtract each node's actual allocation and restore it on completion
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=16)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0, mem_bytes=1 << 30, memsw_bytes=1 << 30),
+        task_res=ResourceSpec(cpu=2.0),
+        ntasks=6, ntasks_per_node_min=1, ntasks_per_node_max=8,
+        node_num=2, sim_runtime=10.0), now=0.0)
+    assert jid > 0
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [jid]
+    job = sched.job_info(jid)
+    assert sorted(job.task_layout) == [1, 5]
+    for n, t in zip(job.node_ids, job.task_layout):
+        node = meta.nodes[n]
+        assert node.avail[0] == node.total[0] - (1 + 2 * t) * 256
+    cluster.advance_to(20.0)
+    sched.schedule_cycle(now=20.0)
+    assert sched.job_info(jid).status == JobStatus.COMPLETED
+    for node in meta.nodes.values():
+        assert (node.avail == node.total).all()
+
+
+def test_exclusive_job_owns_whole_node():
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=8)
+    small = sched.submit(spec(cpu=1.0, sim_runtime=100.0), now=0.0)
+    excl = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0), exclusive=True, sim_runtime=50.0),
+        now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert set(started) == {small, excl}
+    enode = meta.nodes[sched.job_info(excl).node_ids[0]]
+    assert (enode.avail == 0).all()  # whole node consumed
+    assert sched.job_info(excl).node_ids != sched.job_info(small).node_ids
+    cluster.advance_to(60.0)
+    sched.schedule_cycle(now=60.0)
+    assert (enode.avail == enode.total).all()
+
+
 def test_multifactor_priority_orders_cycle():
     meta, sched, cluster = make_cluster(num_nodes=1, cpu=4)
     # one node, one slot: high-qos job submitted later must start first

@@ -1,0 +1,171 @@
+"""Packed solver parity (task packing + exclusive nodes) vs NumPy oracle,
+plus behavioral cases from the reference semantics
+(get_max_tasks cpp:6171-6186, exclusive cpp:6248-6262, task distribution
+cpp:6305-6344)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cranesched_tpu.models.packing import (
+    PackedJobBatch,
+    solve_packed,
+)
+from cranesched_tpu.models.solver import make_cluster_state
+from cranesched_tpu.ops.resources import ResourceLayout
+from cranesched_tpu.testing.packing_oracle import solve_packed_oracle
+
+LAY = ResourceLayout()
+
+
+def to_batch(jobs, num_nodes):
+    J = len(jobs)
+
+    def col(k, dt):
+        return jnp.asarray(np.array([j[k] for j in jobs], dt))
+
+    return PackedJobBatch(
+        node_req=jnp.asarray(np.stack([j["node_req"] for j in jobs])),
+        task_req=jnp.asarray(np.stack([j["task_req"] for j in jobs])),
+        ntasks=col("ntasks", np.int32),
+        ntasks_min=col("ntasks_min", np.int32),
+        ntasks_max=col("ntasks_max", np.int32),
+        node_num=col("node_num", np.int32),
+        time_limit=col("time_limit", np.int32),
+        part_mask=jnp.asarray(np.stack([j["part_mask"] for j in jobs])),
+        exclusive=col("exclusive", bool),
+        valid=col("valid", bool),
+    )
+
+
+def job(node_req=None, task_req=None, ntasks=1, ntasks_min=1,
+        ntasks_max=1, node_num=1, time_limit=3600, part_mask=None,
+        exclusive=False, valid=True, num_nodes=1):
+    return dict(
+        node_req=(node_req if node_req is not None else LAY.encode()),
+        task_req=(task_req if task_req is not None else LAY.encode()),
+        ntasks=ntasks, ntasks_min=ntasks_min, ntasks_max=ntasks_max,
+        node_num=node_num, time_limit=time_limit,
+        part_mask=(part_mask if part_mask is not None
+                   else np.ones(num_nodes, bool)),
+        exclusive=exclusive, valid=valid)
+
+
+def assert_parity(state_np, jobs, max_nodes):
+    avail, total, alive, cost = state_np
+    state = make_cluster_state(avail, total, alive, cost)
+    batch = to_batch(jobs, avail.shape[0])
+    placements, new_state = solve_packed(state, batch,
+                                         max_nodes=max_nodes)
+    o_placed, o_nodes, o_tasks, o_reason, o_avail, o_cost = \
+        solve_packed_oracle(avail, total, alive, cost, jobs, max_nodes)
+    np.testing.assert_array_equal(np.asarray(placements.placed), o_placed)
+    np.testing.assert_array_equal(np.asarray(placements.nodes), o_nodes)
+    np.testing.assert_array_equal(np.asarray(placements.tasks), o_tasks)
+    np.testing.assert_array_equal(np.asarray(placements.reason), o_reason)
+    np.testing.assert_array_equal(np.asarray(new_state.avail), o_avail)
+    np.testing.assert_array_equal(np.asarray(new_state.cost), o_cost)
+    return placements
+
+
+def fresh(num_nodes, cpu=16, mem_gb=32):
+    total = np.tile(LAY.encode(cpu=cpu, mem_bytes=mem_gb << 30,
+                               is_capacity=True), (num_nodes, 1))
+    return (total.copy(), total, np.ones(num_nodes, bool),
+            np.zeros(num_nodes, np.int32))
+
+
+def test_tasks_pack_onto_fewest_needed_nodes():
+    # 6 tasks of 2 cpu over 2 nodes of 16 cpu: one node could hold all,
+    # but node_num=2 forces a gang; distribution fills smallest first
+    state = fresh(2)
+    jobs = [job(task_req=LAY.encode(cpu=2.0), ntasks=6, ntasks_min=1,
+                ntasks_max=8, node_num=2, num_nodes=2)]
+    p = assert_parity(state, jobs, max_nodes=2)
+    assert bool(p.placed[0])
+    assert sorted(np.asarray(p.tasks)[0].tolist()) == [1, 5]
+
+
+def test_ntasks_max_caps_per_node():
+    state = fresh(3)
+    jobs = [job(task_req=LAY.encode(cpu=1.0), ntasks=9, ntasks_min=1,
+                ntasks_max=3, node_num=3, num_nodes=3)]
+    p = assert_parity(state, jobs, max_nodes=3)
+    assert bool(p.placed[0])
+    assert np.asarray(p.tasks)[0].tolist() == [3, 3, 3]
+
+
+def test_insufficient_combined_capacity_fails():
+    state = fresh(2, cpu=4)
+    jobs = [job(task_req=LAY.encode(cpu=2.0), ntasks=8, ntasks_min=1,
+                ntasks_max=8, node_num=2, num_nodes=2)]
+    p = assert_parity(state, jobs, max_nodes=2)
+    assert not bool(p.placed[0])
+
+
+def test_exclusive_requires_idle_node_and_takes_all():
+    avail, total, alive, cost = fresh(2, cpu=8)
+    # node 0 partially used -> only node 1 is exclusive-eligible
+    avail[0] = avail[0] - LAY.encode(cpu=1.0)
+    state = (avail, total, alive, cost)
+    jobs = [job(node_req=LAY.encode(cpu=1.0), exclusive=True,
+                num_nodes=2),
+            job(node_req=LAY.encode(cpu=1.0), num_nodes=2)]
+    p = assert_parity(state, jobs, max_nodes=1)
+    assert bool(p.placed[0])
+    assert np.asarray(p.nodes)[0, 0] == 1
+    # the exclusive job consumed node 1 entirely: the 1-cpu job must go
+    # to node 0 even though node 1 "had room" for it nominally
+    assert np.asarray(p.nodes)[1, 0] == 0
+
+
+def test_min_tasks_per_node_enforced():
+    # ntasks_min=4 of 2 cpu = 8 cpu minimum per node; 4-cpu nodes refuse
+    state = fresh(2, cpu=4)
+    jobs = [job(task_req=LAY.encode(cpu=2.0), ntasks=8, ntasks_min=4,
+                ntasks_max=8, node_num=2, num_nodes=2)]
+    p = assert_parity(state, jobs, max_nodes=2)
+    assert not bool(p.placed[0])
+
+
+def test_node_req_plus_task_req_combined():
+    # per node: base 1 cpu + 3 tasks x 2 cpu = 7 cpu of an 8-cpu node
+    state = fresh(1, cpu=8)
+    jobs = [job(node_req=LAY.encode(cpu=1.0),
+                task_req=LAY.encode(cpu=2.0), ntasks=3, ntasks_min=1,
+                ntasks_max=4, node_num=1, num_nodes=1)]
+    p = assert_parity(state, jobs, max_nodes=1)
+    assert bool(p.placed[0])
+    assert np.asarray(p.tasks)[0, 0] == 3
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_packed_parity(seed):
+    rng = np.random.default_rng(seed)
+    N, J = 12, 40
+    total = np.stack([
+        LAY.encode(cpu=int(rng.integers(8, 33)),
+                   mem_bytes=int(rng.integers(16, 65)) << 30,
+                   is_capacity=True) for _ in range(N)])
+    avail = total.copy()
+    alive = rng.random(N) > 0.1
+    cost = rng.integers(0, 50, N).astype(np.int32)
+    jobs = []
+    for _ in range(J):
+        nn = int(rng.integers(1, 4))
+        nt_min = int(rng.integers(1, 3))
+        nt_max = nt_min + int(rng.integers(0, 4))
+        ntasks = int(rng.integers(nn, nn * nt_max + 1))
+        jobs.append(job(
+            node_req=LAY.encode(cpu=float(rng.integers(0, 3)),
+                                mem_bytes=int(rng.integers(0, 3)) << 30),
+            task_req=LAY.encode(cpu=float(rng.integers(1, 5)),
+                                mem_bytes=int(rng.integers(0, 5)) << 30),
+            ntasks=ntasks, ntasks_min=nt_min, ntasks_max=nt_max,
+            node_num=nn,
+            time_limit=int(rng.integers(60, 86400)),
+            part_mask=rng.random(N) > 0.15,
+            exclusive=bool(rng.random() < 0.15),
+            valid=bool(rng.random() > 0.05),
+            num_nodes=N))
+    assert_parity((avail, total, alive, cost), jobs, max_nodes=4)
