@@ -87,7 +87,10 @@ class JobSpec:
     ntasks_per_node_max: int = 1
     exclusive: bool = False           # whole idle nodes only (cpp:6248)
     time_limit: int = 3600            # seconds
-    qos_priority: int = 0
+    qos: str = ""                     # QoS name (resolved via accounting;
+                                      # account default when empty)
+    qos_priority: int = 0             # direct priority when accounting is
+                                      # not configured
     held: bool = False
     include_nodes: Sequence[str] = ()
     exclude_nodes: Sequence[str] = ()
@@ -109,6 +112,8 @@ class Job:
     spec: JobSpec
     submit_time: float
     status: JobStatus = JobStatus.PENDING
+    qos_name: str = ""                    # resolved QoS (accounting)
+    qos_priority: int = 0                 # effective qos priority
     held: bool = False                    # runtime hold flag (mutable;
                                           # seeded from spec.held at submit)
     cancel_requested: bool = False        # persisted cancel intent: survives
@@ -125,6 +130,10 @@ class Job:
     # (derived state — not persisted; cleared on requeue)
     alloc_cache: list | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # run-limit usage actually taken for this incarnation (keeps the
+    # accounting free symmetric even if the QoS is deleted mid-run)
+    run_usage_taken: bool = dataclasses.field(
+        default=False, repr=False, compare=False)
     priority: float = 0.0
 
     def reset_for_requeue(self) -> None:

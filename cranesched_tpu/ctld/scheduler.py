@@ -34,6 +34,7 @@ from cranesched_tpu.ctld.defs import (
     JobStatus,
     PendingReason,
 )
+from cranesched_tpu.ctld.accounting import AccountMetaContainer
 from cranesched_tpu.ctld.meta import MetaContainer
 from cranesched_tpu.models.priority import (
     PendingPriorityAttrs,
@@ -112,11 +113,16 @@ class JobScheduler:
     def __init__(self, meta: MetaContainer,
                  config: SchedulerConfig | None = None,
                  dispatch: Callable[[Job, list[int]], None] | None = None,
-                 wal=None):
+                 wal=None, accounts=None):
         self.meta = meta
         self.config = config or SchedulerConfig()
         self.dispatch = dispatch or (lambda job, nodes: None)
         self.wal = wal
+        # accounting (reference AccountManager + AccountMetaContainer):
+        # None = open system, no limit enforcement
+        self.accounts = accounts
+        self.account_meta = (AccountMetaContainer(meta.layout)
+                             if accounts is not None else None)
         self.pending: dict[int, Job] = {}    # job_id -> Job, insertion = id order
         self.running: dict[int, Job] = {}
         self.history: dict[int, Job] = {}    # terminal jobs
@@ -153,12 +159,31 @@ class JobScheduler:
                          * spec.ntasks_per_node_min)
         if not (req <= self.meta.partition_max_total(spec.partition)).all():
             return 0
-        if spec.ntasks is not None and spec.ntasks < spec.node_num:
-            return 0  # every node must host at least one task
+        if spec.ntasks is not None:
+            nt_max = max(spec.ntasks_per_node_max,
+                         spec.ntasks_per_node_min)
+            if not (spec.node_num <= spec.ntasks
+                    <= spec.node_num * nt_max):
+                return 0  # every node hosts >= 1 task and the gang's
+                          # combined per-node cap must cover ntasks
+
+        qos_name, qos_priority = "", spec.qos_priority
+        if self.accounts is not None:
+            qos, err = self.accounts.resolve_submit(
+                spec.user, spec.account, spec.partition, spec.qos or None)
+            if err:
+                return 0
+            if qos is not None:
+                err = self.account_meta.try_malloc_submit(
+                    spec.user, spec.account, qos, spec)
+                if err:
+                    return 0
+                qos_name, qos_priority = qos.name, qos.priority
 
         job_id = self._next_job_id
         self._next_job_id += 1
         job = Job(job_id=job_id, spec=spec, submit_time=now,
+                  qos_name=qos_name, qos_priority=qos_priority,
                   held=spec.held)
         if spec.held:
             job.pending_reason = PendingReason.HELD
@@ -278,8 +303,35 @@ class JobScheduler:
     def _release_job_resources(self, job: Job) -> None:
         self.meta.free_resource(job.job_id, job.node_ids,
                                 self._job_alloc(job))
+        self._free_run_limits(job)
+
+    def _malloc_run_limits(self, job: Job) -> bool:
+        """Schedule-time QoS limit check + usage take (reference
+        CheckAndMallocMetaResource, AccountMetaContainer.h:113).  The
+        take is recorded on the job so the free stays symmetric even if
+        the QoS is deleted/re-created while the job runs."""
+        job.run_usage_taken = False
+        if self.account_meta is None or not job.qos_name:
+            return True
+        qos = self.accounts.qos.get(job.qos_name)
+        if qos is None:
+            return True
+        err = self.account_meta.check_and_malloc_run(
+            job.spec.user, job.spec.account, qos, job.spec)
+        if not err:
+            job.run_usage_taken = True
+        return not err
+
+    def _free_run_limits(self, job: Job) -> None:
+        if self.account_meta is not None and job.run_usage_taken:
+            self.account_meta.free_run(job.spec.user, job.spec.account,
+                                       job.qos_name, job.spec)
+            job.run_usage_taken = False
 
     def _finalize(self, job: Job) -> None:
+        if self.account_meta is not None and job.qos_name:
+            self.account_meta.free_submit(job.spec.user, job.spec.account,
+                                          job.qos_name)
         self.history[job.job_id] = job
         if self.wal is not None:
             self.wal.job_finalized(job)
@@ -494,7 +546,7 @@ class JobScheduler:
             req = job.spec.res.encode(lay)
             total_cpu = float(req[DIM_CPU]) / 256.0 * job.spec.node_num
             total_mem = float(req[DIM_MEM]) * job.spec.node_num
-            return (job.spec.qos_priority,
+            return (job.qos_priority,
                     self.meta.partitions[job.spec.partition].priority,
                     job.spec.node_num, total_cpu, total_mem,
                     self._account_id(job.spec.account))
@@ -636,12 +688,16 @@ class JobScheduler:
             if dirty_nodes.intersection(node_ids):
                 job.pending_reason = PendingReason.RESOURCE
                 continue
+            if not self._malloc_run_limits(job):
+                job.pending_reason = PendingReason.QOS_LIMIT
+                continue
             job.node_ids = node_ids
             job.task_layout = ([int(t) for t, n in
                                 zip(tasks[i], nodes_mat[i]) if n >= 0]
                                if tasks is not None else [])
             if not self.meta.malloc_resource(job.job_id, node_ids,
                                              self._job_alloc(job)):
+                self._free_run_limits(job)
                 job.node_ids = []
                 job.task_layout = []
                 job.alloc_cache = None  # never reuse a failed placement's
@@ -677,11 +733,20 @@ class JobScheduler:
         """
         for job_id, (event, job) in sorted(replayed.items()):
             self._next_job_id = max(self._next_job_id, job_id + 1)
+            if not job.status.is_terminal and (
+                    self.account_meta is not None and job.qos_name):
+                self.account_meta.restore_submit(
+                    job.spec.user, job.spec.account, job.qos_name)
             if job.status.is_terminal:
                 self.history[job_id] = job
             elif job.status == JobStatus.RUNNING:
                 if self.meta.malloc_resource(job_id, job.node_ids,
                                              self._job_alloc(job)):
+                    if (self.account_meta is not None and job.qos_name):
+                        self.account_meta.restore_run(
+                            job.spec.user, job.spec.account, job.qos_name,
+                            job.spec)
+                        job.run_usage_taken = True
                     self.running[job_id] = job
                     if job.cancel_requested:
                         # the kill may have been lost with the crash;
@@ -693,7 +758,7 @@ class JobScheduler:
                     if job.cancel_requested:
                         job.status = JobStatus.CANCELLED
                         job.end_time = now
-                        self.history[job_id] = job
+                        self._finalize(job)  # frees the submit slot too
                         continue
                     job.reset_for_requeue()
                     self.pending[job_id] = job

@@ -69,6 +69,8 @@ def _job_to_dict(job: Job) -> dict:
         "spec": _spec_to_dict(job.spec),
         "submit_time": job.submit_time,
         "status": job.status.name,
+        "qos_name": job.qos_name,
+        "qos_priority": job.qos_priority,
         "held": job.held,
         "cancel_requested": job.cancel_requested,
         "pending_reason": job.pending_reason.name,
@@ -87,6 +89,11 @@ def _job_from_dict(d: dict) -> Job:
         spec=_spec_from_dict(d["spec"]),
         submit_time=d["submit_time"],
         status=JobStatus[d["status"]],
+        qos_name=d.get("qos_name", ""),
+        # records written before the effective-qos field carried the
+        # priority on the spec — fall back there, not to 0
+        qos_priority=d.get("qos_priority",
+                           d.get("spec", {}).get("qos_priority", 0)),
         held=d["held"],
         cancel_requested=d.get("cancel_requested", False),
         pending_reason=PendingReason[d["pending_reason"]],

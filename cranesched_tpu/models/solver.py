@@ -69,6 +69,18 @@ def quantized_dcost(time_limit, req_cpu, cpu_total_f32):
                      / cpu_total_f32).astype(jnp.int32)
 
 
+def normalize_cost_ledger(cost, n: int):
+    """Coerce a cost seed into the int32 ledger.  Float inputs (ledger
+    units) are rounded; integer inputs must NOT round-trip through float32
+    (that would reintroduce the 2^24 exactness cliff for large seeds)."""
+    if cost is None:
+        return jnp.zeros(n, jnp.int32)
+    cost = jnp.asarray(cost)
+    if jnp.issubdtype(cost.dtype, jnp.floating):
+        cost = jnp.round(cost.astype(jnp.float32))
+    return cost.astype(jnp.int32)
+
+
 def cheapest_k(masked_cost, k: int):
     """The k smallest entries of an int32 cost vector, ascending, ties to
     the lowest index.  Returns (values, indices).
@@ -169,15 +181,7 @@ def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
     avail = jnp.asarray(avail, jnp.int32)
     total = jnp.asarray(total, jnp.int32)
     alive = jnp.asarray(alive, bool)
-    if cost is None:
-        cost = jnp.zeros(avail.shape[0], jnp.int32)
-    # float inputs (ledger units) round into the int32 ledger; integer
-    # inputs must NOT round-trip through float32 (would reintroduce the
-    # 2^24 exactness cliff for large seeded costs)
-    cost = jnp.asarray(cost)
-    if jnp.issubdtype(cost.dtype, jnp.floating):
-        cost = jnp.round(cost.astype(jnp.float32))
-    cost = cost.astype(jnp.int32)
+    cost = normalize_cost_ledger(cost, avail.shape[0])
     return ClusterState(avail=avail, total=total, alive=alive, cost=cost)
 
 

@@ -55,6 +55,7 @@ from cranesched_tpu.models.solver import (
     apply_placement,
     cheapest_k,
     decide_job,
+    normalize_cost_ledger,
 )
 
 # start_bucket value for jobs that could not be scheduled in the window
@@ -153,12 +154,7 @@ def make_timed_state(avail, total, alive, run_nodes, run_req,
             jnp.where(oob[:, None], 0, req_flat), mode="drop")
     time_avail = avail[:, None, :] + jnp.cumsum(releases, axis=1)
 
-    if cost is None:
-        cost = jnp.zeros(n, jnp.int32)
-    cost = jnp.asarray(cost)
-    if jnp.issubdtype(cost.dtype, jnp.floating):
-        cost = jnp.round(cost.astype(jnp.float32))
-    cost = cost.astype(jnp.int32)
+    cost = normalize_cost_ledger(cost, n)
     return TimedClusterState(time_avail=time_avail, total=total,
                              alive=jnp.asarray(alive, bool), cost=cost)
 

@@ -1,0 +1,270 @@
+"""Accounting: hierarchy/RBAC CRUD + QoS limit enforcement end to end
+(reference AccountManager.h:33-445, AccountMetaContainer.h:70-265)."""
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.accounting import (
+    Account,
+    AccountingError,
+    AccountManager,
+    AdminLevel,
+    Qos,
+    User,
+)
+
+
+def manager_with_root():
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", uid=0,
+                             admin_level=AdminLevel.ROOT)
+    return mgr
+
+
+def standard_setup(**qos_kw):
+    mgr = manager_with_root()
+    mgr.add_qos("root", Qos(name="normal", priority=100, **qos_kw))
+    mgr.add_account("root", Account(name="hpc", allowed_qos={"normal"},
+                                    default_qos="normal"))
+    mgr.add_user("root", User(name="alice", uid=1001), "hpc")
+    mgr.add_user("root", User(name="bob", uid=1002), "hpc")
+    return mgr
+
+
+def cluster_with(mgr, num_nodes=4, cpu=8, config=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=cpu, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, config or SchedulerConfig(backfill=False),
+                         accounts=mgr)
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+    return meta, sched, cluster
+
+
+def spec(user="alice", account="hpc", cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(user=user, account=account,
+                   res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+# ---- CRUD / RBAC ----
+
+def test_rbac_non_admin_cannot_mutate():
+    mgr = standard_setup()
+    with pytest.raises(AccountingError):
+        mgr.add_qos("alice", Qos(name="sneaky"))
+    with pytest.raises(AccountingError):
+        mgr.add_account("alice", Account(name="mine"))
+    with pytest.raises(AccountingError):
+        mgr.block_user("bob", "alice", "hpc")
+
+
+def test_coordinator_manages_subtree():
+    mgr = standard_setup()
+    mgr.accounts["hpc"].coordinators.add("alice")
+    mgr.add_account("root", Account(name="hpc-sub", parent="hpc"))
+    # alice coordinates hpc -> may manage hpc-sub too
+    mgr.add_user("alice", User(name="carol", uid=1003), "hpc-sub")
+    assert "carol" in mgr.accounts["hpc-sub"].users
+    # but not an unrelated account
+    mgr.add_account("root", Account(name="other"))
+    with pytest.raises(AccountingError):
+        mgr.add_user("alice", User(name="dave", uid=1004), "other")
+
+
+def test_same_admin_level_cannot_control_each_other():
+    mgr = manager_with_root()
+    mgr.users["a1"] = User(name="a1", admin_level=AdminLevel.ADMIN)
+    mgr.users["a2"] = User(name="a2", admin_level=AdminLevel.ADMIN)
+    with pytest.raises(AccountingError):
+        mgr.set_admin_level("a1", "a2", AdminLevel.NONE)
+    mgr.set_admin_level("root", "a2", AdminLevel.NONE)  # root can
+    assert mgr.users["a2"].admin_level == AdminLevel.NONE
+
+
+def test_qos_delete_refused_while_referenced():
+    mgr = standard_setup()
+    with pytest.raises(AccountingError):
+        mgr.delete_qos("root", "normal")   # referenced by account hpc
+    mgr.add_qos("root", Qos(name="unused"))
+    mgr.delete_qos("root", "unused")
+    assert "unused" not in mgr.qos
+
+
+def test_txn_log_records_mutations():
+    mgr = standard_setup()
+    actions = [t["action"] for t in mgr.txn_log]
+    assert actions == ["add_qos", "add_account", "add_user", "add_user"]
+
+
+# ---- submit-time enforcement ----
+
+def test_unknown_user_or_wrong_account_rejected():
+    mgr = standard_setup()
+    meta, sched, cluster = cluster_with(mgr)
+    assert sched.submit(spec(user="mallory"), now=0.0) == 0
+    mgr.add_account("root", Account(name="other", default_qos="normal",
+                                    allowed_qos={"normal"}))
+    assert sched.submit(spec(user="alice", account="other"), now=0.0) == 0
+
+
+def test_blocked_user_and_account_rejected():
+    mgr = standard_setup()
+    meta, sched, cluster = cluster_with(mgr)
+    mgr.block_user("root", "alice", "hpc")
+    assert sched.submit(spec(user="alice"), now=0.0) == 0
+    assert sched.submit(spec(user="bob"), now=0.0) > 0
+    mgr.block_account("root", "hpc")
+    assert sched.submit(spec(user="bob"), now=1.0) == 0
+
+
+def test_max_submit_jobs_per_user():
+    mgr = standard_setup(max_submit_jobs_per_user=2)
+    meta, sched, cluster = cluster_with(mgr)
+    assert sched.submit(spec(), now=0.0) > 0
+    assert sched.submit(spec(), now=0.0) > 0
+    assert sched.submit(spec(), now=0.0) == 0       # slot cap
+    assert sched.submit(spec(user="bob"), now=0.0) > 0  # other user fine
+    # slots free once a job is terminal
+    j = sched.submit(spec(user="bob"), now=0.0)
+    sched.cancel(j, now=0.5)
+    assert sched.submit(spec(user="bob"), now=1.0) > 0
+
+
+def test_max_wall_rejects_long_jobs():
+    mgr = standard_setup(max_wall=3600)
+    meta, sched, cluster = cluster_with(mgr)
+    assert sched.submit(spec(time_limit=7200), now=0.0) == 0
+    assert sched.submit(spec(time_limit=1800), now=0.0) > 0
+
+
+# ---- schedule-time enforcement ----
+
+def test_max_jobs_per_user_serializes_runs():
+    mgr = standard_setup(max_jobs_per_user=1)
+    meta, sched, cluster = cluster_with(mgr)
+    j1 = sched.submit(spec(runtime=10.0), now=0.0)
+    j2 = sched.submit(spec(runtime=10.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [j1]
+    assert sched.job_info(j2).pending_reason == PendingReason.QOS_LIMIT
+    cluster.advance_to(11.0)
+    started = sched.schedule_cycle(now=11.0)
+    assert started == [j2]
+    cluster.run_until_drained(start=12.0)
+    assert all(j.status == JobStatus.COMPLETED
+               for j in sched.history.values())
+
+
+def test_max_cpus_per_user_caps_concurrency():
+    mgr = standard_setup(max_cpus_per_user=4.0)
+    meta, sched, cluster = cluster_with(mgr, num_nodes=4, cpu=8)
+    ids = [sched.submit(spec(cpu=2.0, runtime=20.0), now=0.0)
+           for _ in range(4)]
+    started = sched.schedule_cycle(now=0.0)
+    assert len(started) == 2      # 2 x 2 cpu = the 4-cpu cap
+    for j in ids:
+        if j not in started:
+            assert sched.job_info(j).pending_reason == \
+                PendingReason.QOS_LIMIT
+    cluster.run_until_drained(start=1.0)
+    assert len(sched.history) == 4
+
+
+def test_max_tres_per_account_shared_between_users():
+    lay_probe = MetaContainer().layout
+    cap = lay_probe.encode(cpu=4.0, mem_bytes=1 << 40,
+                           memsw_bytes=1 << 40).astype(np.int64)
+    mgr = standard_setup(max_tres_per_account=cap)
+    meta, sched, cluster = cluster_with(mgr, num_nodes=4, cpu=8)
+    a = sched.submit(spec(user="alice", cpu=2.0, runtime=30.0), now=0.0)
+    b = sched.submit(spec(user="bob", cpu=2.0, runtime=30.0), now=0.0)
+    c = sched.submit(spec(user="bob", cpu=2.0, runtime=30.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert set(started) == {a, b}   # account-wide 4-cpu cap
+    assert sched.job_info(c).pending_reason == PendingReason.QOS_LIMIT
+
+
+def test_qos_priority_feeds_multifactor_sort():
+    mgr = manager_with_root()
+    mgr.add_qos("root", Qos(name="high", priority=1000))
+    mgr.add_qos("root", Qos(name="low", priority=0))
+    mgr.add_account("root", Account(name="hpc",
+                                    allowed_qos={"high", "low"},
+                                    default_qos="low"))
+    mgr.add_user("root", User(name="alice", uid=1001), "hpc")
+    meta, sched, cluster = cluster_with(
+        mgr, num_nodes=1, cpu=4,
+        config=SchedulerConfig(backfill=False))
+    lo = sched.submit(spec(cpu=4.0, runtime=10.0, qos="low"), now=0.0)
+    hi = sched.submit(spec(cpu=4.0, runtime=10.0, qos="high"), now=1.0)
+    started = sched.schedule_cycle(now=2.0)
+    assert started == [hi]
+
+
+def test_qos_deleted_mid_run_keeps_accounting_symmetric():
+    # job B placed while its QoS is deleted must not, on completion,
+    # decrement usage owned by job A under the (re-created) QoS name
+    mgr = standard_setup(max_jobs_per_user=2)
+    meta, sched, cluster = cluster_with(mgr)
+    a = sched.submit(spec(runtime=500.0), now=0.0)
+    b = sched.submit(spec(runtime=10.0), now=0.0)
+    sched.schedule_cycle(now=0.0)       # A and B run, usage jobs=2
+    # delete the QoS out from under the running jobs
+    mgr.accounts["hpc"].allowed_qos.discard("normal")
+    mgr.qos["normal"].reference_count = 0
+    mgr.delete_qos("root", "normal")
+    cluster.advance_to(11.0)
+    sched.schedule_cycle(now=11.0)      # B completes; frees ITS usage
+    usage = sched.account_meta._user[("normal", "alice")]
+    assert usage.jobs == 1              # A's slot intact
+
+
+def test_submit_rejects_impossible_packed_shape():
+    mgr = standard_setup()
+    meta, sched, cluster = cluster_with(mgr)
+    # ntasks beyond the gang's combined per-node cap can never run
+    assert sched.submit(
+        spec(ntasks=10, node_num=2, ntasks_per_node_max=2,
+             task_res=ResourceSpec(cpu=0.5)), now=0.0) == 0
+    assert sched.submit(
+        spec(ntasks=4, node_num=2, ntasks_per_node_max=2,
+             task_res=ResourceSpec(cpu=0.5)), now=0.0) > 0
+
+
+def test_limits_restored_after_crash_recovery(tmp_path):
+    from cranesched_tpu.ctld.wal import WriteAheadLog
+    mgr = standard_setup(max_jobs_per_user=1)
+    path = str(tmp_path / "wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = cluster_with(mgr)
+    sched.wal = wal
+    j1 = sched.submit(spec(runtime=500.0), now=0.0)
+    j2 = sched.submit(spec(runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    wal.close()
+
+    mgr2 = standard_setup(max_jobs_per_user=1)
+    meta2, sched2, cluster2 = cluster_with(mgr2)
+    sched2.recover(WriteAheadLog.replay(path), now=1.0)
+    assert sched2.job_info(j1).status == JobStatus.RUNNING
+    # the recovered running job still occupies its MaxJobsPerUser slot
+    assert sched2.schedule_cycle(now=2.0) == []
+    assert sched2.job_info(j2).pending_reason == PendingReason.QOS_LIMIT
