@@ -64,6 +64,7 @@ class SimCluster:
             for node_id in scheduler.meta.nodes
         }
         self._events: list[_Completion] = []
+        self._frozen: dict[int, tuple] = {}
         self.now = 0.0
 
     # -- ctld-facing stubs (the dispatch seam) --
@@ -88,6 +89,28 @@ class SimCluster:
                 start + runtime, job.job_id, status,
                 job.spec.sim_exit_code, job.requeue_count))
 
+    def suspend(self, job_id: int, now: float) -> None:
+        """Freezer analog: pull the completion event, remember remaining
+        runtime (reference cgroup freezer keeps the process image)."""
+        job = self.scheduler.running.get(job_id)
+        rqc = job.requeue_count if job is not None else 0
+        for i, ev in enumerate(self._events):
+            if ev.job_id == job_id and ev.requeue_count == rqc:
+                self._events.pop(i)
+                heapq.heapify(self._events)
+                self._frozen[job_id] = (max(ev.time - now, 0.0),
+                                        ev.status, ev.exit_code,
+                                        ev.requeue_count)
+                return
+
+    def resume(self, job_id: int, now: float) -> None:
+        frozen = self._frozen.pop(job_id, None)
+        if frozen is None:
+            return
+        remaining, status, exit_code, rqc = frozen
+        heapq.heappush(self._events, _Completion(
+            now + remaining, job_id, status, exit_code, rqc))
+
     def terminate(self, job_id: int, now: float | None = None) -> None:
         """TerminateSteps analog: immediate kill + Cancelled upcall.
         ``now`` is the ctld-side cancel time (the cluster clock may lag)."""
@@ -95,6 +118,7 @@ class SimCluster:
         if job is None:
             return
         when = self.now if now is None else max(now, self.now)
+        self._frozen.pop(job_id, None)
         self._remove_step_everywhere(job_id)
         self.scheduler.step_status_change(job_id, JobStatus.CANCELLED,
                                           130, when)
@@ -155,6 +179,16 @@ class SimCluster:
                             for j in sched.pending.values()
                             if j.spec.begin_time is not None
                             and j.spec.begin_time > now and not j.held)
+            # per-edge dependency delays become satisfiable in the future
+            for j in sched.pending.values():
+                if j.held:
+                    continue
+                times = [v for v in j.dep_state.values()
+                         if v is not None and v != float("inf")
+                         and v > now]
+                if times:
+                    horizons.append(max(times) if not j.spec.deps_is_or
+                                    else min(times))
             if not horizons:
                 if all(j.held for j in sched.pending.values()):
                     return now  # only held jobs remain

@@ -24,6 +24,8 @@ class JobStatus(enum.Enum):
 
     PENDING = "Pending"
     RUNNING = "Running"
+    SUSPENDED = "Suspended"         # frozen via cgroup freezer; keeps
+                                    # its allocation
     COMPLETED = "Completed"         # exit code 0
     FAILED = "Failed"               # nonzero exit
     EXCEED_TIME_LIMIT = "ExceedTimeLimit"
@@ -31,7 +33,52 @@ class JobStatus(enum.Enum):
 
     @property
     def is_terminal(self) -> bool:
-        return self not in (JobStatus.PENDING, JobStatus.RUNNING)
+        return self not in (JobStatus.PENDING, JobStatus.RUNNING,
+                            JobStatus.SUSPENDED)
+
+    @property
+    def is_failed_kind(self) -> bool:
+        """The 'not ok' terminal family for AFTER_NOT_OK dependencies."""
+        return self in (JobStatus.FAILED, JobStatus.EXCEED_TIME_LIMIT,
+                        JobStatus.CANCELLED)
+
+
+class DepType(enum.Enum):
+    """Job dependency types (reference PublicDefs.proto:136-152)."""
+
+    AFTER = "after"              # satisfied when the dependee STARTS
+    AFTER_ANY = "afterany"       # satisfied when it reaches ANY terminal
+    AFTER_OK = "afterok"         # terminal Completed; else never
+    AFTER_NOT_OK = "afternotok"  # terminal failed-kind; else never
+
+
+@dataclasses.dataclass(frozen=True)
+class Dependency:
+    """One dependency edge with optional per-edge delay
+    (reference Dependencies, PublicDefs.proto:136-152)."""
+
+    job_id: int
+    type: DepType = DepType.AFTER_OK
+    delay_seconds: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ArraySpec:
+    """Job array shape (reference ArraySpec, PublicDefs.proto:154-159):
+    task ids start..end step stride; at most max_concurrent children run
+    at once (0 = unlimited — the %N suffix)."""
+
+    start: int
+    end: int
+    stride: int = 1
+    max_concurrent: int = 0
+
+    def task_ids(self) -> list[int]:
+        return list(range(self.start, self.end + 1, self.stride))
+
+
+# dependency edge state sentinel: edge can never be satisfied
+DEP_NEVER = float("inf")
 
 
 class PendingReason(str, enum.Enum):
@@ -96,6 +143,15 @@ class JobSpec:
     exclude_nodes: Sequence[str] = ()
     begin_time: float | None = None   # epoch seconds; None = now
     requeue_if_failed: bool = False
+    # dependencies (4 types w/ per-edge delay; AND by default, OR when
+    # deps_is_or — reference Dependencies.is_or)
+    dependencies: Sequence[Dependency] = ()
+    deps_is_or: bool = False
+    # job arrays: this spec becomes a pending template; children
+    # materialize one per cycle (reference ArrayManager, Array.h:124)
+    array: ArraySpec | None = None
+    # named reservation to run inside (reference ResvMeta)
+    reservation: str = ""
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None
@@ -126,6 +182,21 @@ class Job:
     node_ids: list[int] = dataclasses.field(default_factory=list)
     task_layout: list[int] = dataclasses.field(default_factory=list)
     requeue_count: int = 0
+    # dependency edge state: dep job_id -> earliest satisfiable time, or
+    # DEP_NEVER (event-driven, reference AddDependent /
+    # TriggerTerminalDependencyEvents, CtldPublicDefs.cpp:1750-1775)
+    dep_state: dict[int, float | None] = dataclasses.field(
+        default_factory=dict)
+    # array bookkeeping: children carry (parent, task id); the parent is
+    # a template tracking materialization (reference ArrayMeta)
+    array_parent_id: int | None = None
+    array_task_id: int | None = None
+    array_remaining: list[int] = dataclasses.field(default_factory=list)
+    array_children: list[int] = dataclasses.field(default_factory=list)
+    # suspend/resume: suspended wall time is credited back to the time
+    # limit (reference JobScheduler.cpp:118-126)
+    suspend_time: float | None = None
+    suspended_total: float = 0.0
     # cached per-node allocation vectors for the current incarnation
     # (derived state — not persisted; cleared on requeue)
     alloc_cache: list | None = dataclasses.field(

@@ -61,6 +61,34 @@ class NodeMeta:
         return self.alive and not self.drained
 
 
+@dataclasses.dataclass
+class Reservation:
+    """Named time-windowed node carve-out (reference ResvMeta,
+    NodeDefs.h:83-98; CreateReservationRequest Crane.proto:692-707):
+    during [start_time, end_time) the nodes belong exclusively to jobs
+    that name the reservation (and pass its ACL)."""
+
+    name: str
+    partition: str
+    node_ids: set[int]
+    start_time: float
+    end_time: float
+    allowed_accounts: set[str] | None = None   # None = all
+    denied_accounts: set[str] = dataclasses.field(default_factory=set)
+
+    def active(self, now: float) -> bool:
+        return self.start_time <= now < self.end_time
+
+    def expired(self, now: float) -> bool:
+        return now >= self.end_time
+
+    def account_allowed(self, account: str) -> bool:
+        if account in self.denied_accounts:
+            return False
+        return (self.allowed_accounts is None
+                or account in self.allowed_accounts)
+
+
 @dataclasses.dataclass(frozen=True)
 class ResReduceEvent:
     """A resource reduction that happened while a cycle was in flight
@@ -86,6 +114,9 @@ class MetaContainer:
         self._part_max_cache: dict[str, np.ndarray] = {}
         self._events: list[ResReduceEvent] = []
         self._logging = False
+        self.reservations: dict[str, Reservation] = {}
+        # bumped on any reservation change so mask caches invalidate
+        self.resv_epoch = 0
 
     # ---- topology ----
 
@@ -133,6 +164,59 @@ class MetaContainer:
                 out = np.maximum(out, self.nodes[i].total)
         self._part_max_cache[partition] = out
         return out
+
+    # ---- reservations (reference CreateReservation handling +
+    #      reservation scheduling domains, JobScheduler.cpp:6624-6732) ----
+
+    def create_reservation(self, name: str, partition: str,
+                           node_names: Iterable[str], start_time: float,
+                           end_time: float,
+                           allowed_accounts: Iterable[str] | None = None,
+                           denied_accounts: Iterable[str] = ()
+                           ) -> Reservation | None:
+        """Returns None on conflict (name taken, unknown nodes, or node
+        already in an overlapping reservation)."""
+        if name in self.reservations or end_time <= start_time:
+            return None
+        ids = set()
+        for nm in node_names:
+            if nm not in self._name_to_id:
+                return None
+            ids.add(self._name_to_id[nm])
+        part = self.partitions.get(partition)
+        if part is None or not ids <= part.node_ids:
+            return None
+        for other in self.reservations.values():
+            if (ids & other.node_ids
+                    and start_time < other.end_time
+                    and other.start_time < end_time):
+                return None
+        resv = Reservation(
+            name=name, partition=partition, node_ids=ids,
+            start_time=start_time, end_time=end_time,
+            allowed_accounts=(set(allowed_accounts)
+                              if allowed_accounts is not None else None),
+            denied_accounts=set(denied_accounts))
+        self.reservations[name] = resv
+        self.resv_epoch += 1
+        return resv
+
+    def delete_reservation(self, name: str) -> bool:
+        if name not in self.reservations:
+            return False
+        del self.reservations[name]
+        self.resv_epoch += 1
+        return True
+
+    def purge_expired_reservations(self, now: float) -> list[str]:
+        """Cycle-start cleanup (reference reservation cleanup thread +
+        timers, JobScheduler.h:1471-1482)."""
+        gone = [n for n, r in self.reservations.items() if r.expired(now)]
+        for n in gone:
+            del self.reservations[n]
+        if gone:
+            self.resv_epoch += 1
+        return gone
 
     # ---- liveness (reference CranedUp/CranedDown,
     #      CranedMetaContainer.h:105-124) ----

@@ -29,6 +29,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from cranesched_tpu.ctld.defs import (
+    DEP_NEVER,
+    DepType,
     Job,
     JobSpec,
     JobStatus,
@@ -131,6 +133,8 @@ class JobScheduler:
         self._next_job_id = 1
         self._account_index: dict[str, int] = {}
         self._mask_cache: dict[tuple, np.ndarray] = {}
+        self._mask_cache_epoch = -1
+        self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
 
     # ------------------------------------------------------------------
     # submit / cancel / hold (reference SubmitJobToScheduler :3405,
@@ -167,6 +171,13 @@ class JobScheduler:
                 return 0  # every node hosts >= 1 task and the gang's
                           # combined per-node cap must cover ntasks
 
+        if spec.reservation:
+            resv = self.meta.reservations.get(spec.reservation)
+            if resv is None or not resv.account_allowed(spec.account):
+                return 0
+        if spec.array is not None and not spec.array.task_ids():
+            return 0
+
         qos_name, qos_priority = "", spec.qos_priority
         if self.accounts is not None:
             qos, err = self.accounts.resolve_submit(
@@ -187,17 +198,115 @@ class JobScheduler:
                   held=spec.held)
         if spec.held:
             job.pending_reason = PendingReason.HELD
+        if spec.array is not None:
+            job.array_remaining = spec.array.task_ids()
+        self._register_dependencies(job)
         self.pending[job_id] = job
         if self.wal is not None:
             self.wal.job_submitted(job)
         return job_id
+
+    # ------------------------------------------------------------------
+    # dependencies (reference: event-driven, AddDependent
+    # CtldPublicDefs.cpp:1750, start triggers AFTER JobScheduler.cpp:1873,
+    # terminal triggers ANY/OK/NOT_OK with InfiniteFuture for the failed
+    # branch :1768-1775)
+    # ------------------------------------------------------------------
+
+    def _register_dependencies(self, job: Job) -> None:
+        for dep in job.spec.dependencies:
+            target = self.job_info(dep.job_id)
+            if target is None:
+                job.dep_state[dep.job_id] = DEP_NEVER
+                continue
+            sat = self._dep_satisfied_time(dep, target)
+            job.dep_state[dep.job_id] = sat
+            if sat is None:   # still waiting on an event
+                self._dependents.setdefault(dep.job_id, set()).add(
+                    job.job_id)
+
+    @staticmethod
+    def _dep_satisfied_time(dep, target: Job) -> float | None:
+        """Edge state from the dependee's CURRENT state: a timestamp
+        (satisfiable from then + delay), DEP_NEVER, or None (waiting)."""
+        if dep.type == DepType.AFTER:
+            if target.start_time is not None:
+                return target.start_time + dep.delay_seconds
+            if target.status.is_terminal:   # never started and never will
+                return (target.end_time or 0.0) + dep.delay_seconds \
+                    if target.status == JobStatus.COMPLETED else DEP_NEVER
+            return None
+        if not target.status.is_terminal:
+            return None
+        end = target.end_time or 0.0
+        if dep.type == DepType.AFTER_ANY:
+            return end + dep.delay_seconds
+        if dep.type == DepType.AFTER_OK:
+            return (end + dep.delay_seconds
+                    if target.status == JobStatus.COMPLETED else DEP_NEVER)
+        # AFTER_NOT_OK
+        return (end + dep.delay_seconds
+                if target.status.is_failed_kind else DEP_NEVER)
+
+    def _trigger_dep_event(self, target: Job) -> None:
+        """Re-evaluate waiting edges of this job's dependents."""
+        waiting = self._dependents.get(target.job_id)
+        if not waiting:
+            return
+        done = set()
+        for jid in waiting:
+            dep_job = self.pending.get(jid)
+            if dep_job is None:
+                done.add(jid)
+                continue
+            for dep in dep_job.spec.dependencies:
+                if dep.job_id != target.job_id:
+                    continue
+                sat = self._dep_satisfied_time(dep, target)
+                if sat is not None:
+                    dep_job.dep_state[dep.job_id] = sat
+            if all(v is not None
+                   for v in dep_job.dep_state.values()):
+                done.add(jid)
+        if target.status.is_terminal:
+            self._dependents.pop(target.job_id, None)
+        else:
+            waiting -= done
+
+    def _deps_runnable(self, job: Job, now: float) -> PendingReason | None:
+        """None = runnable; else the pending reason to surface."""
+        if not job.dep_state:
+            return None
+        states = list(job.dep_state.values())
+        if job.spec.deps_is_or:
+            if any(v is not None and v != DEP_NEVER and v <= now
+                   for v in states):
+                return None
+            if all(v == DEP_NEVER for v in states):
+                return PendingReason.DEPENDENCY_NEVER_SATISFIED
+            return PendingReason.DEPENDENCY
+        # AND combination
+        if any(v == DEP_NEVER for v in states):
+            return PendingReason.DEPENDENCY_NEVER_SATISFIED
+        if all(v is not None and v <= now for v in states):
+            return None
+        return PendingReason.DEPENDENCY
 
     def cancel(self, job_id: int, now: float) -> bool:
         if job_id in self.pending:
             job = self.pending.pop(job_id)
             job.status = JobStatus.CANCELLED
             job.end_time = now
+            if job.spec.array is not None:
+                # cancel the template: drop unmaterialized tasks and
+                # cancel live children
+                job.array_remaining = []
+                for c in list(job.array_children):
+                    self.cancel(c, now)
             self._finalize(job)
+            self._trigger_dep_event(job)
+            if job.array_parent_id is not None:
+                self._on_array_child_terminal(job)
             return True
         if job_id in self.running:
             # real system: TerminateSteps RPC → craned kills → status
@@ -264,6 +373,9 @@ class JobScheduler:
                     self.wal.job_requeued(job)
             else:
                 self._finalize(job)
+                self._trigger_dep_event(job)
+                if job.array_parent_id is not None:
+                    self._on_array_child_terminal(job)
         return n
 
     def _should_requeue(self, job: Job, ch: StatusChange) -> bool:
@@ -329,12 +441,57 @@ class JobScheduler:
             job.run_usage_taken = False
 
     def _finalize(self, job: Job) -> None:
-        if self.account_meta is not None and job.qos_name:
+        # array children never took a submit slot (the template owns it)
+        if (self.account_meta is not None and job.qos_name
+                and job.array_parent_id is None):
             self.account_meta.free_submit(job.spec.user, job.spec.account,
                                           job.qos_name)
         self.history[job.job_id] = job
         if self.wal is not None:
             self.wal.job_finalized(job)
+
+    # ------------------------------------------------------------------
+    # suspend / resume (reference SuspendJobByCgroup/ResumeJobByCgroup,
+    # JobManager.h:150-152; suspended time credited back to the limit,
+    # JobScheduler.cpp:118-126)
+    # ------------------------------------------------------------------
+
+    def suspend(self, job_id: int, now: float) -> bool:
+        job = self.running.get(job_id)
+        if job is None or job.status != JobStatus.RUNNING:
+            return False
+        job.status = JobStatus.SUSPENDED
+        job.suspend_time = now
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        self.dispatch_suspend(job_id, now)
+        return True
+
+    def resume(self, job_id: int, now: float) -> bool:
+        job = self.running.get(job_id)
+        if job is None or job.status != JobStatus.SUSPENDED:
+            return False
+        job.suspended_total += max(now - (job.suspend_time or now), 0.0)
+        job.suspend_time = None
+        job.status = JobStatus.RUNNING
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        self.dispatch_resume(job_id, now)
+        return True
+
+    def dispatch_suspend(self, job_id: int, now: float) -> None:
+        """Transport seam: freeze the job's cgroups on its nodes."""
+
+    def dispatch_resume(self, job_id: int, now: float) -> None:
+        """Transport seam: thaw the job's cgroups."""
+
+    def _effective_end(self, job: Job, now: float) -> float:
+        """Expected end with suspended time credited back."""
+        start = job.start_time if job.start_time is not None else now
+        suspended = job.suspended_total
+        if job.suspend_time is not None:   # currently frozen
+            suspended += max(now - job.suspend_time, 0.0)
+        return start + job.spec.time_limit + suspended
 
     # ------------------------------------------------------------------
     # node failure (reference CranedDown → TerminateJobsOnCraned,
@@ -377,6 +534,8 @@ class JobScheduler:
         """One cycle: drain status changes, snapshot, device solve, commit,
         dispatch.  Returns the job_ids started this cycle."""
         self.process_status_changes()
+        self.meta.purge_expired_reservations(now)
+        self._materialize_array_children(now)
 
         candidates = self._pending_candidates(now)
         if not candidates:
@@ -392,7 +551,8 @@ class JobScheduler:
         avail, total, alive = self.meta.snapshot()
 
         ordered = self._priority_sort(candidates, now)
-        jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0])
+        jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0],
+                                                  now)
         cost0 = self._initial_cost(now, total)
 
         # cycles containing packed/exclusive jobs route to the
@@ -430,7 +590,7 @@ class JobScheduler:
         cost = Σ (end - now) * cpu / cpu_total)."""
         cost = np.zeros(total.shape[0], np.int64)
         for job in self.running.values():
-            end = (job.start_time or now) + job.spec.time_limit
+            end = self._effective_end(job, now)
             remaining = max(end - now, 0.0)
             for n, alloc in zip(job.node_ids, self._job_alloc(job)):
                 cpus = float(alloc[DIM_CPU]) / CPU_SCALE
@@ -450,7 +610,7 @@ class JobScheduler:
         # differ per node, so each allocation releases its own amount
         rows = []
         for job in self.running.values():
-            end = (job.start_time or now) + job.spec.time_limit
+            end = self._effective_end(job, now)
             # overdue jobs (end <= now) are about to be killed but still
             # hold resources: release no earlier than bucket 1
             eb = max(int(np.ceil((end - now) / res)), 1)
@@ -508,17 +668,82 @@ class JobScheduler:
                              dur_buckets=jnp.asarray(dur),
                              part_mask=batch.part_mask, valid=batch.valid)
 
+    # ------------------------------------------------------------------
+    # job arrays (reference ArrayManager, Array.h:51-177: the parent is a
+    # pending template; the scheduler materializes at most ONE child per
+    # parent per cycle, bounded by the %N run limit)
+    # ------------------------------------------------------------------
+
+    def _materialize_array_children(self, now: float) -> None:
+        for parent in list(self.pending.values()):
+            if parent.spec.array is None or not parent.array_remaining:
+                continue
+            if parent.held:
+                continue
+            if self._deps_runnable(parent, now) is not None:
+                continue
+            limit = parent.spec.array.max_concurrent
+            live = sum(1 for c in parent.array_children
+                       if not (self.job_info(c) or parent).status
+                       .is_terminal)
+            if limit and live >= limit:
+                continue
+            task_id = parent.array_remaining.pop(0)
+            child_spec = dataclasses.replace(
+                parent.spec, array=None,
+                name=f"{parent.spec.name}_{task_id}")
+            child_id = self._next_job_id
+            self._next_job_id += 1
+            child = Job(job_id=child_id, spec=child_spec,
+                        submit_time=parent.submit_time,
+                        qos_name=parent.qos_name,
+                        qos_priority=parent.qos_priority,
+                        array_parent_id=parent.job_id,
+                        array_task_id=task_id)
+            parent.array_children.append(child_id)
+            self.pending[child_id] = child
+            if self.wal is not None:
+                self.wal.job_submitted(child)
+                self.wal.job_updated(parent)
+
+    def _on_array_child_terminal(self, child: Job) -> None:
+        """Reference OnChildTerminal: parent finishes when every task id
+        has materialized and reached a terminal state."""
+        parent = self.pending.get(child.array_parent_id)
+        if parent is None:
+            return
+        if not parent.array_remaining and all(
+                (self.job_info(c) is not None
+                 and self.job_info(c).status.is_terminal)
+                for c in parent.array_children):
+            del self.pending[parent.job_id]
+            statuses = [self.job_info(c).status
+                        for c in parent.array_children]
+            parent.status = (
+                JobStatus.COMPLETED
+                if all(st == JobStatus.COMPLETED for st in statuses)
+                else JobStatus.FAILED)
+            parent.end_time = child.end_time
+            self._finalize(parent)
+            self._trigger_dep_event(parent)
+
     def _pending_candidates(self, now: float) -> list[Job]:
         """Skip held / future-begin-time jobs (cpp:1374-1413); dependency
         gating joins here once dependencies land."""
         out = []
         for job in self.pending.values():  # id order == insertion order
+            if job.spec.array is not None:
+                continue  # templates never run; children materialize
             if job.held:
                 job.pending_reason = PendingReason.HELD
                 continue
             if job.spec.begin_time is not None and (
                     job.spec.begin_time > now):
                 job.pending_reason = PendingReason.BEGIN_TIME
+                continue
+            dep_reason = self._deps_runnable(job, now)
+            if dep_reason is not None:
+                job.pending_reason = dep_reason
                 continue
             out.append(job)
         return out
@@ -612,19 +837,50 @@ class JobScheduler:
             b *= 2
         return b
 
-    def _mask_for(self, job: Job) -> np.ndarray:
+    def _mask_for(self, job: Job, now: float = 0.0) -> np.ndarray:
+        if self._mask_cache_epoch != self.meta.resv_epoch:
+            # reservation churn invalidates reservation-derived masks;
+            # drop everything so stale epochs can't accumulate
+            self._mask_cache.clear()
+            self._mask_cache_epoch = self.meta.resv_epoch
         key = (job.spec.partition, tuple(job.spec.include_nodes),
-               tuple(job.spec.exclude_nodes), len(self.meta.nodes))
+               tuple(job.spec.exclude_nodes), len(self.meta.nodes),
+               job.spec.reservation)
         mask = self._mask_cache.get(key)
         if mask is None:
             mask = self.meta.partition_mask(
                 job.spec.partition, job.spec.include_nodes,
                 job.spec.exclude_nodes)
+            if job.spec.reservation:
+                # reservation jobs run ONLY inside their carve-out
+                # (reference: reservations are their own LocalScheduler
+                # domain, JobScheduler.cpp:6624-6732)
+                resv = self.meta.reservations.get(job.spec.reservation)
+                rmask = np.zeros(len(self.meta.nodes), bool)
+                if resv is not None:
+                    for n in resv.node_ids:
+                        rmask[n] = True
+                mask = mask & rmask
             self._mask_cache[key] = mask
+        if job.spec.reservation:
+            resv = self.meta.reservations.get(job.spec.reservation)
+            if resv is None or not resv.active(now):
+                return np.zeros(len(self.meta.nodes), bool)
+            return mask
+        # non-reservation jobs must stay clear of any reservation whose
+        # window overlaps this job's would-be runtime [now, now+limit]
+        # (reference "Resource Reserved" check, cpp:6797-6810)
+        if self.meta.reservations:
+            mask = mask.copy()
+            end = now + job.spec.time_limit
+            for resv in self.meta.reservations.values():
+                if now < resv.end_time and resv.start_time < end:
+                    for n in resv.node_ids:
+                        mask[n] = False
         return mask
 
-    def _build_batch(self, ordered: list[Job], num_nodes: int
-                     ) -> tuple[JobBatch, int]:
+    def _build_batch(self, ordered: list[Job], num_nodes: int,
+                     now: float = 0.0) -> tuple[JobBatch, int]:
         lay = self.meta.layout
         J = self._bucket(len(ordered))
         req = np.zeros((J, lay.num_dims), np.int32)
@@ -636,7 +892,7 @@ class JobScheduler:
             req[i] = job.spec.res.encode(lay)
             node_num[i] = job.spec.node_num
             time_limit[i] = job.spec.time_limit
-            part_mask[i] = self._mask_for(job)
+            part_mask[i] = self._mask_for(job, now)
             valid[i] = True
         max_nodes = max(1, min(int(node_num.max(initial=1)),
                                self.config.max_nodes_per_job))
@@ -711,6 +967,7 @@ class JobScheduler:
             self.running[job.job_id] = job
             if self.wal is not None:
                 self.wal.job_started(job)
+            self._trigger_dep_event(job)   # AFTER edges fire on start
             self.dispatch(job, node_ids)
             started.append(job.job_id)
         return started
@@ -734,7 +991,8 @@ class JobScheduler:
         for job_id, (event, job) in sorted(replayed.items()):
             self._next_job_id = max(self._next_job_id, job_id + 1)
             if not job.status.is_terminal and (
-                    self.account_meta is not None and job.qos_name):
+                    self.account_meta is not None and job.qos_name
+                    and job.array_parent_id is None):
                 self.account_meta.restore_submit(
                     job.spec.user, job.spec.account, job.qos_name)
             if job.status.is_terminal:
@@ -762,9 +1020,39 @@ class JobScheduler:
                         continue
                     job.reset_for_requeue()
                     self.pending[job_id] = job
+            elif job.status == JobStatus.SUSPENDED:
+                # suspended jobs hold their allocation across the crash
+                if self.meta.malloc_resource(job_id, job.node_ids,
+                                             self._job_alloc(job)):
+                    if (self.account_meta is not None and job.qos_name):
+                        self.account_meta.restore_run(
+                            job.spec.user, job.spec.account, job.qos_name,
+                            job.spec)
+                        job.run_usage_taken = True
+                    self.running[job_id] = job
+                else:
+                    job.reset_for_requeue()
+                    self.pending[job_id] = job
             else:
                 job.status = JobStatus.PENDING
                 self.pending[job_id] = job
+        # re-derive waiting edges against the CURRENT state of each
+        # dependee (events that fired between the WAL snapshot and the
+        # crash would otherwise be lost forever), then rebuild the
+        # dependents map for edges still waiting
+        for job in self.pending.values():
+            for dep in job.spec.dependencies:
+                if job.dep_state.get(dep.job_id) is not None:
+                    continue
+                target = self.job_info(dep.job_id)
+                if target is None:
+                    job.dep_state[dep.job_id] = DEP_NEVER
+                    continue
+                sat = self._dep_satisfied_time(dep, target)
+                job.dep_state[dep.job_id] = sat
+                if sat is None:
+                    self._dependents.setdefault(dep.job_id, set()).add(
+                        job.job_id)
 
     def job_info(self, job_id: int) -> Job | None:
         return (self.pending.get(job_id) or self.running.get(job_id)

@@ -21,7 +21,16 @@ import json
 import os
 from typing import IO
 
-from cranesched_tpu.ctld.defs import Job, JobSpec, JobStatus, PendingReason, ResourceSpec
+from cranesched_tpu.ctld.defs import (
+    ArraySpec,
+    Dependency,
+    DepType,
+    Job,
+    JobSpec,
+    JobStatus,
+    PendingReason,
+    ResourceSpec,
+)
 
 
 def _res_to_dict(res: dict) -> dict:
@@ -38,6 +47,10 @@ def _spec_to_dict(spec: JobSpec) -> dict:
     d["task_res"] = _res_to_dict(task_res) if task_res else None
     d["include_nodes"] = list(spec.include_nodes)
     d["exclude_nodes"] = list(spec.exclude_nodes)
+    d["dependencies"] = [[dep.job_id, dep.type.name, dep.delay_seconds]
+                         for dep in spec.dependencies]
+    d["array"] = (dataclasses.asdict(spec.array)
+                  if spec.array is not None else None)
     return d
 
 
@@ -58,6 +71,12 @@ def _spec_from_dict(d: dict) -> JobSpec:
     d["task_res"] = _res_from_dict(task_res) if task_res else None
     d["include_nodes"] = tuple(d.get("include_nodes") or ())
     d["exclude_nodes"] = tuple(d.get("exclude_nodes") or ())
+    d["dependencies"] = tuple(
+        Dependency(job_id=dep[0], type=DepType[dep[1]],
+                   delay_seconds=dep[2])
+        for dep in (d.get("dependencies") or ()))
+    arr = d.get("array")
+    d["array"] = ArraySpec(**arr) if arr else None
     # forward compatibility: records written by older versions may carry
     # fields the current JobSpec no longer has — drop, don't crash
     return JobSpec(**{k: v for k, v in d.items() if k in _SPEC_FIELDS})
@@ -80,6 +99,15 @@ def _job_to_dict(job: Job) -> dict:
         "node_ids": job.node_ids,
         "task_layout": job.task_layout,
         "requeue_count": job.requeue_count,
+        "dep_state": {str(k): (None if v is None
+                               else ("never" if v == float("inf") else v))
+                      for k, v in job.dep_state.items()},
+        "array_parent_id": job.array_parent_id,
+        "array_task_id": job.array_task_id,
+        "array_remaining": job.array_remaining,
+        "array_children": job.array_children,
+        "suspend_time": job.suspend_time,
+        "suspended_total": job.suspended_total,
     }
 
 
@@ -103,6 +131,15 @@ def _job_from_dict(d: dict) -> Job:
         node_ids=list(d["node_ids"]),
         task_layout=list(d.get("task_layout") or ()),
         requeue_count=d["requeue_count"],
+        dep_state={int(k): (None if v is None
+                            else (float("inf") if v == "never" else v))
+                   for k, v in (d.get("dep_state") or {}).items()},
+        array_parent_id=d.get("array_parent_id"),
+        array_task_id=d.get("array_task_id"),
+        array_remaining=list(d.get("array_remaining") or ()),
+        array_children=list(d.get("array_children") or ()),
+        suspend_time=d.get("suspend_time"),
+        suspended_total=d.get("suspended_total", 0.0),
     )
 
 

@@ -1,0 +1,359 @@
+"""Capability semantics: dependencies, job arrays, reservations,
+suspend/resume (reference SURVEY §2.8; PublicDefs.proto:136-159,
+Array.h:51-177, NodeDefs.h:83-98, JobManager.h:150-152)."""
+
+import numpy as np
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.defs import ArraySpec, Dependency, DepType
+from cranesched_tpu.ctld.wal import WriteAheadLog
+
+
+def make_cluster(num_nodes=4, cpu=8, config=None, wal=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=cpu, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, config or SchedulerConfig(backfill=False),
+                         wal=wal)
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+    sched.dispatch_suspend = cluster.suspend
+    sched.dispatch_resume = cluster.resume
+    return meta, sched, cluster
+
+
+def spec(cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+# ---------------- dependencies ----------------
+
+def test_afterok_waits_for_success():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=10.0), now=0.0)
+    b = sched.submit(spec(dependencies=(Dependency(a, DepType.AFTER_OK),)),
+                     now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [a]
+    assert sched.job_info(b).pending_reason == PendingReason.DEPENDENCY
+    cluster.advance_to(11.0)
+    started = sched.schedule_cycle(now=11.0)
+    assert started == [b]
+
+
+def test_afterok_never_satisfied_on_failure():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=5.0, sim_exit_code=1), now=0.0)
+    b = sched.submit(spec(dependencies=(Dependency(a, DepType.AFTER_OK),)),
+                     now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(6.0)
+    sched.schedule_cycle(now=6.0)
+    assert sched.job_info(a).status == JobStatus.FAILED
+    sched.schedule_cycle(now=7.0)
+    assert sched.job_info(b).pending_reason == \
+        PendingReason.DEPENDENCY_NEVER_SATISFIED
+
+
+def test_afternotok_fires_on_failure():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=5.0, sim_exit_code=1), now=0.0)
+    cleanup = sched.submit(
+        spec(dependencies=(Dependency(a, DepType.AFTER_NOT_OK),),
+             runtime=5.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(6.0)
+    started = sched.schedule_cycle(now=6.0)
+    assert started == [cleanup]
+
+
+def test_after_fires_on_start_with_delay():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=100.0), now=0.0)
+    b = sched.submit(
+        spec(dependencies=(Dependency(a, DepType.AFTER,
+                                      delay_seconds=30.0),)), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [a]   # b's edge satisfied at start+30
+    assert sched.schedule_cycle(now=10.0) == []
+    assert sched.job_info(b).pending_reason == PendingReason.DEPENDENCY
+    assert sched.schedule_cycle(now=31.0) == [b]
+
+
+def test_or_dependencies_any_edge_suffices():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=5.0, sim_exit_code=1), now=0.0)
+    b = sched.submit(spec(runtime=200.0), now=0.0)
+    c = sched.submit(
+        spec(dependencies=(Dependency(a, DepType.AFTER_OK),
+                           Dependency(b, DepType.AFTER,
+                                      delay_seconds=0.0)),
+             deps_is_or=True), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    # b started -> the OR is satisfied even though a will fail
+    assert set(started) == {a, b}
+    assert sched.schedule_cycle(now=1.0) == [c]
+
+
+def test_dependency_on_unknown_job_never_satisfied():
+    meta, sched, cluster = make_cluster()
+    b = sched.submit(
+        spec(dependencies=(Dependency(9999, DepType.AFTER_ANY),)),
+        now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(b).pending_reason == \
+        PendingReason.DEPENDENCY_NEVER_SATISFIED
+
+
+def test_dependency_on_already_finished_job():
+    meta, sched, cluster = make_cluster()
+    a = sched.submit(spec(runtime=1.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(2.0)
+    sched.schedule_cycle(now=2.0)
+    assert sched.job_info(a).status == JobStatus.COMPLETED
+    b = sched.submit(spec(dependencies=(Dependency(a, DepType.AFTER_OK),)),
+                     now=3.0)
+    assert sched.schedule_cycle(now=3.0) == [b]
+
+
+def test_dependency_survives_crash_after_dependee_finished(tmp_path):
+    # B depends on A; A completes; ctld crashes BEFORE B runs.  Recovery
+    # must re-derive the edge from A's terminal state in history — not
+    # wait forever on an event that already fired.
+    path = str(tmp_path / "wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = make_cluster(wal=wal)
+    a = sched.submit(spec(runtime=5.0), now=0.0)
+    b = sched.submit(spec(dependencies=(Dependency(a, DepType.AFTER_OK),)),
+                     now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(6.0)
+    sched.process_status_changes()    # A completes; no placement cycle
+    wal.close()
+
+    meta2, sched2, cluster2 = make_cluster()
+    sched2.recover(WriteAheadLog.replay(path), now=7.0)
+    started = sched2.schedule_cycle(now=7.0)
+    assert started == [b]
+
+
+def test_cancelled_pending_child_finalizes_parent():
+    meta, sched, cluster = make_cluster(num_nodes=1, cpu=1)
+    parent = sched.submit(
+        spec(cpu=1.0, runtime=5.0, array=ArraySpec(start=0, end=1)),
+        now=0.0)
+    sched.schedule_cycle(now=0.0)   # child 0 materializes and runs
+    cluster.advance_to(6.0)
+    sched.schedule_cycle(now=6.0)   # child 0 done; child 1 materializes
+    sched.schedule_cycle(now=7.0)
+    pending_children = [j for j in sched.pending.values()
+                        if j.array_parent_id == parent]
+    running_children = [j for j in sched.running.values()
+                        if j.array_parent_id == parent]
+    for c in pending_children + running_children:
+        sched.cancel(c.job_id, now=8.0)
+    sched.schedule_cycle(now=9.0)
+    # the template must reach a terminal state, not linger forever
+    p = sched.job_info(parent)
+    assert p.status.is_terminal
+
+
+# ---------------- job arrays ----------------
+
+def test_array_materializes_one_child_per_cycle():
+    meta, sched, cluster = make_cluster(num_nodes=8)
+    parent = sched.submit(
+        spec(runtime=100.0, array=ArraySpec(start=0, end=3)), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert len(started) == 1           # one child materialized per cycle
+    child = sched.job_info(started[0])
+    assert child.array_parent_id == parent
+    assert child.array_task_id == 0
+    assert child.spec.name.endswith("_0")
+    for cyc in range(1, 4):
+        started = sched.schedule_cycle(now=float(cyc))
+        assert len(started) == 1
+    assert sched.schedule_cycle(now=5.0) == []   # all 4 materialized
+
+
+def test_array_run_limit_percent_n():
+    meta, sched, cluster = make_cluster(num_nodes=8)
+    sched.submit(spec(runtime=50.0,
+                      array=ArraySpec(start=0, end=5, max_concurrent=2)),
+                 now=0.0)
+    for cyc in range(6):
+        sched.schedule_cycle(now=float(cyc))
+    # only 2 children may run at once
+    assert len(sched.running) == 2
+    end = cluster.run_until_drained(start=6.0, max_cycles=5000)
+    children = [j for j in sched.history.values()
+                if j.array_task_id is not None]
+    assert len(children) == 6
+    assert all(j.status == JobStatus.COMPLETED for j in children)
+
+
+def test_array_parent_completes_after_children():
+    meta, sched, cluster = make_cluster(num_nodes=8)
+    parent = sched.submit(
+        spec(runtime=10.0, array=ArraySpec(start=1, end=2)), now=0.0)
+    cluster.run_until_drained(start=0.0, max_cycles=1000)
+    p = sched.job_info(parent)
+    assert p.status == JobStatus.COMPLETED
+    assert len(p.array_children) == 2
+
+
+def test_array_cancel_cancels_remaining():
+    meta, sched, cluster = make_cluster(num_nodes=2, cpu=2)
+    parent = sched.submit(
+        spec(cpu=2.0, runtime=100.0, array=ArraySpec(start=0, end=9)),
+        now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sched.schedule_cycle(now=1.0)   # two children running
+    running_children = list(sched.running)
+    sched.cancel(parent, now=2.0)
+    sched.schedule_cycle(now=3.0)
+    p = sched.job_info(parent)
+    assert p.status == JobStatus.CANCELLED
+    for c in running_children:
+        assert sched.job_info(c).status == JobStatus.CANCELLED
+    assert not sched.pending and not sched.running
+
+
+# ---------------- reservations ----------------
+
+def test_reservation_excludes_outside_jobs():
+    meta, sched, cluster = make_cluster(num_nodes=4, cpu=8)
+    assert meta.create_reservation(
+        "maint", "default", ["cn00", "cn01"], start_time=0.0,
+        end_time=1000.0) is not None
+    # a non-reservation job with a window overlapping the reservation
+    # must avoid cn00/cn01
+    j = sched.submit(spec(cpu=8.0, time_limit=500), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert sched.job_info(j).node_ids[0] >= 2
+    # a reservation job runs inside the carve-out
+    r = sched.submit(spec(cpu=8.0, reservation="maint", time_limit=500,
+                          runtime=10.0), now=1.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(r).node_ids[0] < 2
+
+
+def test_reservation_acl():
+    meta, sched, cluster = make_cluster(num_nodes=2)
+    meta.create_reservation("vip", "default", ["cn00"], 0.0, 1000.0,
+                            allowed_accounts=["special"])
+    # the default account is not on the reservation's allow list
+    assert sched.submit(spec(reservation="vip"), now=0.0) == 0
+    # the allowed account submits fine
+    assert sched.submit(spec(account="special", reservation="vip"),
+                        now=0.0) > 0
+    # deny list beats allow list
+    meta.reservations["vip"].denied_accounts.add("special")
+    assert sched.submit(spec(account="special", reservation="vip"),
+                        now=1.0) == 0
+
+
+def test_reservation_expiry_frees_nodes():
+    meta, sched, cluster = make_cluster(num_nodes=1, cpu=8)
+    meta.create_reservation("soon", "default", ["cn00"], 0.0, 100.0)
+    j = sched.submit(spec(cpu=8.0, time_limit=500, runtime=10.0), now=0.0)
+    assert sched.schedule_cycle(now=0.0) == []   # only node reserved
+    # after expiry the node frees and the job runs
+    assert sched.schedule_cycle(now=100.0) == [j]
+    assert "soon" not in meta.reservations
+
+
+def test_reservation_overlap_rejected():
+    meta, sched, cluster = make_cluster(num_nodes=2)
+    assert meta.create_reservation("r1", "default", ["cn00"], 0.0,
+                                   100.0) is not None
+    assert meta.create_reservation("r2", "default", ["cn00"], 50.0,
+                                   150.0) is None     # overlapping node
+    assert meta.create_reservation("r3", "default", ["cn00"], 100.0,
+                                   200.0) is not None  # back-to-back ok
+    assert meta.create_reservation("r4", "default", ["cn01"], 0.0,
+                                   100.0) is not None  # disjoint node
+
+
+def test_future_reservation_blocks_overlapping_window_only():
+    meta, sched, cluster = make_cluster(num_nodes=1, cpu=8)
+    meta.create_reservation("later", "default", ["cn00"], 1000.0, 2000.0)
+    # short job finishes before the reservation starts -> allowed
+    short = sched.submit(spec(cpu=8.0, time_limit=500, runtime=10.0),
+                         now=0.0)
+    # long job would run into the reservation -> blocked
+    lng = sched.submit(spec(cpu=8.0, time_limit=1500, runtime=10.0),
+                       now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert short in started and lng not in started
+
+
+# ---------------- suspend / resume ----------------
+
+def test_suspend_resume_credits_time():
+    meta, sched, cluster = make_cluster()
+    j = sched.submit(spec(runtime=100.0, time_limit=3600), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert sched.suspend(j, now=10.0)
+    assert sched.job_info(j).status == JobStatus.SUSPENDED
+    # frozen: does not complete at t=100
+    cluster.advance_to(150.0)
+    sched.schedule_cycle(now=150.0)
+    assert sched.job_info(j).status == JobStatus.SUSPENDED
+    assert sched.resume(j, now=200.0)
+    job = sched.job_info(j)
+    assert job.status == JobStatus.RUNNING
+    assert job.suspended_total == 190.0
+    # completes after the remaining 90s of runtime
+    cluster.advance_to(291.0)
+    sched.schedule_cycle(now=291.0)
+    assert sched.job_info(j).status == JobStatus.COMPLETED
+    assert sched.job_info(j).end_time == 290.0
+
+
+def test_suspended_job_keeps_resources():
+    meta, sched, cluster = make_cluster(num_nodes=1, cpu=4)
+    a = sched.submit(spec(cpu=4.0, runtime=100.0), now=0.0)
+    b = sched.submit(spec(cpu=4.0, runtime=10.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sched.suspend(a, now=1.0)
+    # the freezer keeps memory/cpu allocated: b must NOT start
+    assert sched.schedule_cycle(now=2.0) == []
+    sched.resume(a, now=3.0)
+    cluster.run_until_drained(start=4.0, max_cycles=1000)
+    assert sched.job_info(b).status == JobStatus.COMPLETED
+
+
+def test_suspended_job_recovers_as_suspended(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = make_cluster(wal=wal)
+    j = sched.submit(spec(runtime=100.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sched.suspend(j, now=5.0)
+    wal.close()
+
+    meta2, sched2, cluster2 = make_cluster()
+    sched2.recover(WriteAheadLog.replay(path), now=6.0)
+    job = sched2.job_info(j)
+    assert job.status == JobStatus.SUSPENDED
+    assert j in sched2.running
+    node = meta2.nodes[job.node_ids[0]]
+    assert node.avail[0] < node.total[0]   # allocation held
