@@ -1,0 +1,305 @@
+"""Command-line tools: the user surface of the framework.
+
+Mirrors the reference's Go CLI command set (reference docs/en/command/:
+cbatch, cqueue, cinfo, ccancel, ccontrol, cacct — SURVEY.md §2.7) as
+subcommands of one entry point:
+
+    python -m cranesched_tpu.cli cbatch --cpu 4 --mem 8G --time 3600
+    python -m cranesched_tpu.cli cqueue
+    python -m cranesched_tpu.cli cinfo
+    python -m cranesched_tpu.cli ccancel 42
+    python -m cranesched_tpu.cli ccontrol hold 42
+    python -m cranesched_tpu.cli cacct
+
+The server address comes from --server or $CRANE_SERVER
+(default 127.0.0.1:50051).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _parse_mem(text: str) -> int:
+    units = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+    text = text.strip().lower().removesuffix("b")
+    if text and text[-1] in units:
+        return int(float(text[:-1]) * units[text[-1]])
+    return int(text)
+
+
+def _parse_array(text: str):
+    """'0-9', '0-9:2' (stride), '%N' run-limit suffix: '0-9%2'."""
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    limit = 0
+    if "%" in text:
+        text, lim = text.split("%", 1)
+        limit = int(lim)
+    stride = 1
+    if ":" in text:
+        text, st = text.split(":", 1)
+        stride = int(st)
+    if "-" in text:
+        start, end = text.split("-", 1)
+    else:
+        start = end = text
+    return pb.ArraySpec(start=int(start), end=int(end), stride=stride,
+                        max_concurrent=limit)
+
+
+def _parse_dependency(text: str):
+    """'afterok:12', 'after:12+30' (delay), comma-separated."""
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    deps = []
+    for part in text.split(","):
+        typ, sep, ref = part.partition(":")
+        if not sep or not ref:
+            raise SystemExit(
+                f"crane: invalid dependency {part!r} "
+                "(expected TYPE:JOBID[+delay], e.g. afterok:12)")
+        delay = 0.0
+        if "+" in ref:
+            ref, d = ref.split("+", 1)
+            delay = float(d)
+        try:
+            job_id = int(ref)
+        except ValueError:
+            raise SystemExit(f"crane: invalid dependency job id {ref!r}")
+        deps.append(pb.Dependency(job_id=job_id, type=typ,
+                                  delay_seconds=delay))
+    return deps
+
+
+def _client(args):
+    from cranesched_tpu.rpc.client import CtldClient
+    return CtldClient(args.server)
+
+
+def _fmt_table(rows, headers) -> str:
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              if rows else len(str(h)) for i, h in enumerate(headers)]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def cmd_cbatch(args) -> int:
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    spec = pb.JobSpec(
+        name=args.job_name, user=args.user,
+        account=args.account, partition=args.partition,
+        res=pb.ResourceSpec(cpu=args.cpu, mem_bytes=_parse_mem(args.mem),
+                            memsw_bytes=_parse_mem(args.memsw or args.mem)),
+        node_num=args.nodes, time_limit=args.time, qos=args.qos,
+        held=args.hold, exclusive=args.exclusive,
+        reservation=args.reservation,
+        include_nodes=args.nodelist.split(",") if args.nodelist else [],
+        exclude_nodes=args.exclude.split(",") if args.exclude else [],
+        requeue_if_failed=args.requeue,
+        deps_is_or=args.dependency_any,
+        sim_runtime=args.sim_runtime or 0.0)
+    if args.ntasks:
+        spec.ntasks = args.ntasks
+        spec.ntasks_per_node_min = args.ntasks_per_node_min
+        spec.ntasks_per_node_max = (args.ntasks_per_node_max
+                                    or args.ntasks)
+        spec.task_res.CopyFrom(pb.ResourceSpec(
+            cpu=args.cpus_per_task,
+            mem_bytes=_parse_mem(args.mem_per_task)))
+    if args.array:
+        spec.array.CopyFrom(_parse_array(args.array))
+    if args.dependency:
+        spec.dependencies.extend(_parse_dependency(args.dependency))
+    client = _client(args)
+    reply = client.submit(spec)
+    if reply.job_id:
+        print(f"Submitted batch job {reply.job_id}")
+        return 0
+    print(f"submit failed: {reply.error}", file=sys.stderr)
+    return 1
+
+
+def cmd_cqueue(args) -> int:
+    client = _client(args)
+    reply = client.query_jobs(user=args.user, partition=args.partition,
+                              include_history=args.history)
+    rows = []
+    for j in reply.jobs:
+        rows.append((j.job_id, j.name[:20], j.user, j.partition,
+                     j.status, j.pending_reason or "-",
+                     ",".join(j.node_names) or "-"))
+    print(_fmt_table(rows, ("JOBID", "NAME", "USER", "PARTITION",
+                            "STATE", "REASON", "NODES")))
+    return 0
+
+
+def cmd_cinfo(args) -> int:
+    client = _client(args)
+    reply = client.query_cluster()
+    rows = []
+    for n in reply.nodes:
+        rows.append((n.name, ",".join(n.partitions), n.state,
+                     f"{n.cpu_avail:g}/{n.cpu_total:g}",
+                     f"{n.mem_avail >> 30}G/{n.mem_total >> 30}G",
+                     n.running_jobs))
+    print(_fmt_table(rows, ("NODE", "PARTITIONS", "STATE", "CPU(A/T)",
+                            "MEM(A/T)", "JOBS")))
+    return 0
+
+
+def cmd_ccancel(args) -> int:
+    client = _client(args)
+    rc = 0
+    for job_id in args.job_ids:
+        reply = client.cancel(job_id)
+        if not reply.ok:
+            print(f"ccancel {job_id}: {reply.error}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_ccontrol(args) -> int:
+    client = _client(args)
+    if args.action in ("hold", "release"):
+        reply = client.hold(args.job_id, held=args.action == "hold")
+    elif args.action == "suspend":
+        reply = client.suspend(args.job_id)
+    elif args.action == "resume":
+        reply = client.resume(args.job_id)
+    else:
+        print(f"unknown action {args.action}", file=sys.stderr)
+        return 2
+    if not reply.ok:
+        print(f"ccontrol: {reply.error}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_cacct(args) -> int:
+    client = _client(args)
+    reply = client.query_jobs(user=args.user, include_history=True)
+    rows = []
+    for j in reply.jobs:
+        if j.status in ("Pending", "Running", "Suspended"):
+            continue
+        wall = (j.end_time - j.start_time
+                if j.end_time and j.start_time else 0.0)
+        rows.append((j.job_id, j.name[:20], j.user, j.status,
+                     j.exit_code, f"{wall:.0f}s"))
+    print(_fmt_table(rows, ("JOBID", "NAME", "USER", "STATE",
+                            "EXIT", "WALL")))
+    return 0
+
+
+def cmd_cresv(args) -> int:
+    client = _client(args)
+    if args.action == "create":
+        if not args.nodelist:
+            print("cresv create: --nodelist is required",
+                  file=sys.stderr)
+            return 2
+        if args.end <= args.start:
+            print("cresv create: --end must be after --start",
+                  file=sys.stderr)
+            return 2
+        reply = client.create_reservation(
+            args.resv_name, args.partition, args.nodelist.split(","),
+            args.start, args.end,
+            allowed_accounts=(args.accounts.split(",")
+                              if args.accounts else ()))
+    else:
+        reply = client.delete_reservation(args.resv_name)
+    if not reply.ok:
+        print(f"cresv: {reply.error}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    top = argparse.ArgumentParser(prog="crane")
+    top.add_argument("--server",
+                     default=os.environ.get("CRANE_SERVER",
+                                            "127.0.0.1:50051"))
+    sub = top.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("cbatch", help="submit a batch job")
+    p.add_argument("--job-name", "-J", default="job")
+    p.add_argument("--user", default=os.environ.get("USER", "user"))
+    p.add_argument("--account", "-A", default="default")
+    p.add_argument("--partition", "-p", default="default")
+    p.add_argument("--cpu", "-c", type=float, default=1.0)
+    p.add_argument("--mem", default="0")
+    p.add_argument("--memsw", default="")
+    p.add_argument("--nodes", "-N", type=int, default=1)
+    p.add_argument("--time", "-t", type=int, default=3600)
+    p.add_argument("--qos", "-q", default="")
+    p.add_argument("--hold", action="store_true")
+    p.add_argument("--exclusive", action="store_true")
+    p.add_argument("--reservation", default="")
+    p.add_argument("--nodelist", "-w", default="")
+    p.add_argument("--exclude", "-x", default="")
+    p.add_argument("--requeue", action="store_true")
+    p.add_argument("--array", "-a", default="")
+    p.add_argument("--dependency", "-d", default="")
+    p.add_argument("--dependency-any", action="store_true")
+    p.add_argument("--ntasks", "-n", type=int, default=0)
+    p.add_argument("--ntasks-per-node-min", type=int, default=1)
+    p.add_argument("--ntasks-per-node-max", type=int, default=0)
+    p.add_argument("--cpus-per-task", type=float, default=1.0)
+    p.add_argument("--mem-per-task", default="0")
+    p.add_argument("--sim-runtime", type=float, default=0.0)
+    p.set_defaults(func=cmd_cbatch)
+
+    p = sub.add_parser("cqueue", help="show the job queue")
+    p.add_argument("--user", "-u", default="")
+    p.add_argument("--partition", "-p", default="")
+    p.add_argument("--history", action="store_true")
+    p.set_defaults(func=cmd_cqueue)
+
+    p = sub.add_parser("cinfo", help="show cluster nodes")
+    p.set_defaults(func=cmd_cinfo)
+
+    p = sub.add_parser("ccancel", help="cancel jobs")
+    p.add_argument("job_ids", nargs="+", type=int)
+    p.set_defaults(func=cmd_ccancel)
+
+    p = sub.add_parser("ccontrol", help="hold/release/suspend/resume")
+    p.add_argument("action",
+                   choices=["hold", "release", "suspend", "resume"])
+    p.add_argument("job_id", type=int)
+    p.set_defaults(func=cmd_ccontrol)
+
+    p = sub.add_parser("cacct", help="show accounting history")
+    p.add_argument("--user", "-u", default="")
+    p.set_defaults(func=cmd_cacct)
+
+    p = sub.add_parser("cresv", help="manage reservations")
+    p.add_argument("action", choices=["create", "delete"])
+    p.add_argument("resv_name")
+    p.add_argument("--partition", "-p", default="default")
+    p.add_argument("--nodelist", "-w", default="")
+    p.add_argument("--start", type=float, default=0.0)
+    p.add_argument("--end", type=float, default=0.0)
+    p.add_argument("--accounts", default="")
+    p.set_defaults(func=cmd_cresv)
+
+    return top
+
+
+def main(argv=None) -> int:
+    import grpc
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except grpc.RpcError as exc:
+        code = exc.code().name if hasattr(exc, "code") else "RPC_ERROR"
+        print(f"crane: cannot reach ctld at {args.server} ({code})",
+              file=sys.stderr)
+        return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

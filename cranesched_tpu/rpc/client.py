@@ -1,0 +1,115 @@
+"""Client stub for the CraneCtld service (hand-glued; used by the CLI
+and by node daemons)."""
+
+from __future__ import annotations
+
+import grpc
+
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.consts import SERVICE
+
+
+class CtldClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._stubs = {}
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _call(self, name, request, reply_cls):
+        stub = self._stubs.get(name)
+        if stub is None:
+            stub = self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=reply_cls.FromString)
+            self._stubs[name] = stub
+        return stub(request, timeout=self.timeout)
+
+    # ---- external ----
+
+    def submit(self, spec: pb.JobSpec) -> pb.SubmitJobReply:
+        return self._call("SubmitBatchJob", pb.SubmitJobRequest(spec=spec),
+                          pb.SubmitJobReply)
+
+    def submit_many(self, specs) -> pb.SubmitJobsReply:
+        return self._call("SubmitBatchJobs",
+                          pb.SubmitJobsRequest(specs=list(specs)),
+                          pb.SubmitJobsReply)
+
+    def cancel(self, job_id: int) -> pb.OkReply:
+        return self._call("CancelJob", pb.JobIdRequest(job_id=job_id),
+                          pb.OkReply)
+
+    def hold(self, job_id: int, held: bool = True) -> pb.OkReply:
+        return self._call("HoldJob",
+                          pb.HoldRequest(job_id=job_id, held=held),
+                          pb.OkReply)
+
+    def suspend(self, job_id: int) -> pb.OkReply:
+        return self._call("SuspendJob", pb.JobIdRequest(job_id=job_id),
+                          pb.OkReply)
+
+    def resume(self, job_id: int) -> pb.OkReply:
+        return self._call("ResumeJob", pb.JobIdRequest(job_id=job_id),
+                          pb.OkReply)
+
+    def query_jobs(self, job_ids=(), user: str = "", partition: str = "",
+                   include_history: bool = False) -> pb.QueryJobsReply:
+        return self._call(
+            "QueryJobsInfo",
+            pb.QueryJobsRequest(job_ids=list(job_ids), user=user,
+                                partition=partition,
+                                include_history=include_history),
+            pb.QueryJobsReply)
+
+    def query_cluster(self) -> pb.QueryClusterReply:
+        return self._call("QueryClusterInfo", pb.QueryClusterRequest(),
+                          pb.QueryClusterReply)
+
+    def create_reservation(self, name, partition, node_names, start_time,
+                           end_time, allowed_accounts=(),
+                           denied_accounts=()) -> pb.OkReply:
+        return self._call(
+            "CreateReservation",
+            pb.CreateReservationRequest(
+                name=name, partition=partition,
+                node_names=list(node_names), start_time=start_time,
+                end_time=end_time,
+                allowed_accounts=list(allowed_accounts),
+                denied_accounts=list(denied_accounts)),
+            pb.OkReply)
+
+    def delete_reservation(self, name: str) -> pb.OkReply:
+        return self._call("DeleteReservation", pb.NameRequest(name=name),
+                          pb.OkReply)
+
+    # ---- internal ----
+
+    def craned_register(self, name, total: pb.ResourceSpec,
+                        partitions=("default",)
+                        ) -> pb.CranedRegisterReply:
+        return self._call(
+            "CranedRegister",
+            pb.CranedRegisterRequest(name=name, total=total,
+                                     partitions=list(partitions)),
+            pb.CranedRegisterReply)
+
+    def craned_ping(self, node_id: int) -> pb.OkReply:
+        return self._call("CranedPing",
+                          pb.CranedPingRequest(node_id=node_id),
+                          pb.OkReply)
+
+    def step_status_change(self, job_id, status, exit_code,
+                           time) -> pb.OkReply:
+        return self._call(
+            "StepStatusChange",
+            pb.StepStatusChangeRequest(job_id=job_id, status=status,
+                                       exit_code=exit_code, time=time),
+            pb.OkReply)
+
+    def tick(self, now: float) -> pb.TickReply:
+        return self._call("Tick", pb.TickRequest(now=now), pb.TickReply)

@@ -1,0 +1,104 @@
+"""proto <-> domain conversions for the ctld service."""
+
+from __future__ import annotations
+
+from cranesched_tpu.ctld.defs import (
+    ArraySpec,
+    Dependency,
+    DepType,
+    Job,
+    JobSpec,
+    ResourceSpec,
+)
+from cranesched_tpu.rpc import crane_pb2 as pb
+
+_DEP_TYPES = {t.value: t for t in DepType}
+
+
+def res_from_pb(msg) -> ResourceSpec:
+    gres = None
+    if msg.gres:
+        gres = {}
+        for key, count in msg.gres.items():
+            name, _, typ = key.partition(":")
+            gres[(name, typ)] = count
+    return ResourceSpec(cpu=msg.cpu or 0.0, mem_bytes=msg.mem_bytes,
+                        memsw_bytes=msg.memsw_bytes, gres=gres)
+
+
+def res_to_pb(res: ResourceSpec) -> pb.ResourceSpec:
+    msg = pb.ResourceSpec(cpu=res.cpu, mem_bytes=res.mem_bytes,
+                          memsw_bytes=res.memsw_bytes)
+    for (name, typ), count in (res.gres or {}).items():
+        msg.gres[f"{name}:{typ}"] = count
+    return msg
+
+
+def spec_from_pb(msg) -> JobSpec:
+    deps = []
+    for d in msg.dependencies:
+        dep_type = _DEP_TYPES.get(d.type)
+        if dep_type is None:
+            raise ValueError(
+                f"unknown dependency type {d.type!r} "
+                f"(expected one of {sorted(_DEP_TYPES)})")
+        deps.append(Dependency(job_id=d.job_id, type=dep_type,
+                               delay_seconds=d.delay_seconds))
+    deps = tuple(deps)
+    array = None
+    if msg.HasField("array"):
+        array = ArraySpec(start=msg.array.start, end=msg.array.end,
+                          stride=msg.array.stride or 1,
+                          max_concurrent=msg.array.max_concurrent)
+    return JobSpec(
+        name=msg.name or "job",
+        user=msg.user or "user",
+        account=msg.account or "default",
+        partition=msg.partition or "default",
+        res=res_from_pb(msg.res),
+        node_num=msg.node_num or 1,
+        task_res=(res_from_pb(msg.task_res)
+                  if msg.HasField("task_res") else None),
+        ntasks=msg.ntasks or None,
+        ntasks_per_node_min=msg.ntasks_per_node_min or 1,
+        ntasks_per_node_max=msg.ntasks_per_node_max or 1,
+        exclusive=msg.exclusive,
+        time_limit=msg.time_limit or 3600,
+        qos=msg.qos,
+        qos_priority=msg.qos_priority,
+        held=msg.held,
+        include_nodes=tuple(msg.include_nodes),
+        exclude_nodes=tuple(msg.exclude_nodes),
+        begin_time=msg.begin_time or None,
+        requeue_if_failed=msg.requeue_if_failed,
+        dependencies=deps,
+        deps_is_or=msg.deps_is_or,
+        array=array,
+        reservation=msg.reservation,
+        sim_runtime=msg.sim_runtime or None,
+        sim_exit_code=msg.sim_exit_code,
+    )
+
+
+def job_to_pb(job: Job, node_names) -> pb.JobInfo:
+    return pb.JobInfo(
+        job_id=job.job_id,
+        name=job.spec.name,
+        user=job.spec.user,
+        account=job.spec.account,
+        partition=job.spec.partition,
+        status=job.status.value,
+        pending_reason=job.pending_reason.value,
+        node_names=[node_names[n] for n in job.node_ids],
+        task_layout=job.task_layout,
+        submit_time=job.submit_time,
+        start_time=job.start_time or 0.0,
+        end_time=job.end_time or 0.0,
+        exit_code=job.exit_code or 0,
+        requeue_count=job.requeue_count,
+        qos=job.qos_name,
+        priority=job.priority,
+        array_parent_id=job.array_parent_id or 0,
+        array_task_id=(job.array_task_id
+                       if job.array_task_id is not None else -1),
+    )

@@ -1,0 +1,275 @@
+"""The ctld gRPC service: the reference's CtldGrpcServer, hand-glued.
+
+(reference: src/CraneCtld/RpcService/CtldGrpcServer.cpp — SubmitBatchJob
+:691, SubmitBatchJobs :790, the ~60-RPC external surface of
+protos/Crane.proto:1401-1683, and the CraneCtldForInternal craned-facing
+service :1620.)
+
+The scheduler is single-threaded by design; a coarse lock serializes all
+RPC handlers onto it (the reference serializes through per-purpose
+lock-free queues drained by its scheduler threads — same effect, more
+machinery than a Python control plane needs).
+
+Two clock modes:
+* real time: a daemon thread runs schedule_cycle every cycle_interval;
+* virtual time (``tick_mode=True``): nothing runs until a ``Tick`` RPC
+  supplies ``now`` — deterministic for tests, replays, and simulations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from cranesched_tpu.craned.sim import SimCluster, SimCraned
+from cranesched_tpu.ctld.defs import JobStatus
+from cranesched_tpu.ctld.scheduler import JobScheduler
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.consts import SERVICE
+from cranesched_tpu.rpc.convert import job_to_pb, res_from_pb, spec_from_pb
+
+
+def _node_state(node) -> str:
+    if not node.alive:
+        return "DOWN"
+    if node.drained:
+        return "DRAIN"
+    if (node.avail == node.total).all():
+        return "IDLE"
+    if (node.avail == 0).all():
+        return "ALLOC"
+    return "MIXED"
+
+
+class CtldServer:
+    """Wraps a JobScheduler (and optionally a simulated node plane)
+    behind the CraneCtld service."""
+
+    def __init__(self, scheduler: JobScheduler,
+                 sim: SimCluster | None = None,
+                 cycle_interval: float = 1.0, tick_mode: bool = False):
+        self.scheduler = scheduler
+        self.sim = sim
+        self.cycle_interval = cycle_interval
+        self.tick_mode = tick_mode
+        self._lock = threading.Lock()
+        self._server: grpc.Server | None = None
+        self._cycle_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ---- handlers (each is unary-unary; the lock serializes) ----
+
+    def SubmitBatchJob(self, request, context):
+        try:
+            spec = spec_from_pb(request.spec)
+        except ValueError as exc:
+            return pb.SubmitJobReply(job_id=0, error=str(exc))
+        with self._lock:
+            job_id = self.scheduler.submit(spec, now=self._now())
+        return pb.SubmitJobReply(
+            job_id=job_id, error="" if job_id else "rejected")
+
+    def SubmitBatchJobs(self, request, context):
+        now = self._now()
+        replies = []
+        with self._lock:
+            for spec_pb in request.specs:
+                try:
+                    spec = spec_from_pb(spec_pb)
+                except ValueError as exc:
+                    replies.append(pb.SubmitJobReply(job_id=0,
+                                                     error=str(exc)))
+                    continue
+                job_id = self.scheduler.submit(spec, now=now)
+                replies.append(pb.SubmitJobReply(
+                    job_id=job_id, error="" if job_id else "rejected"))
+        return pb.SubmitJobsReply(replies=replies)
+
+    def CancelJob(self, request, context):
+        with self._lock:
+            ok = self.scheduler.cancel(request.job_id, now=self._now())
+        return pb.OkReply(ok=ok, error="" if ok else "no such job")
+
+    def HoldJob(self, request, context):
+        with self._lock:
+            ok = self.scheduler.hold(request.job_id, request.held,
+                                     now=self._now())
+        return pb.OkReply(ok=ok, error="" if ok else "not pending")
+
+    def SuspendJob(self, request, context):
+        with self._lock:
+            ok = self.scheduler.suspend(request.job_id, now=self._now())
+        return pb.OkReply(ok=ok, error="" if ok else "not running")
+
+    def ResumeJob(self, request, context):
+        with self._lock:
+            ok = self.scheduler.resume(request.job_id, now=self._now())
+        return pb.OkReply(ok=ok, error="" if ok else "not suspended")
+
+    def QueryJobsInfo(self, request, context):
+        with self._lock:
+            names = {i: n.name
+                     for i, n in self.scheduler.meta.nodes.items()}
+            jobs = list(self.scheduler.queue())
+            if request.include_history:
+                jobs += list(self.scheduler.history.values())
+            if request.job_ids:
+                wanted = set(request.job_ids)
+                jobs = [j for j in jobs if j.job_id in wanted]
+            if request.user:
+                jobs = [j for j in jobs if j.spec.user == request.user]
+            if request.partition:
+                jobs = [j for j in jobs
+                        if j.spec.partition == request.partition]
+            return pb.QueryJobsReply(
+                jobs=[job_to_pb(j, names) for j in jobs])
+
+    def QueryClusterInfo(self, request, context):
+        from cranesched_tpu.ops.resources import (
+            CPU_SCALE, DIM_CPU, DIM_MEM, MEM_UNIT_BYTES)
+        with self._lock:
+            out = []
+            for node in self.scheduler.meta.nodes.values():
+                out.append(pb.NodeInfo(
+                    name=node.name,
+                    state=_node_state(node),
+                    cpu_total=float(node.total[DIM_CPU]) / CPU_SCALE,
+                    cpu_avail=float(node.avail[DIM_CPU]) / CPU_SCALE,
+                    mem_total=int(node.total[DIM_MEM]) * MEM_UNIT_BYTES,
+                    mem_avail=int(node.avail[DIM_MEM]) * MEM_UNIT_BYTES,
+                    partitions=sorted(node.partitions),
+                    running_jobs=len(node.running_jobs)))
+            return pb.QueryClusterReply(nodes=out)
+
+    def CreateReservation(self, request, context):
+        with self._lock:
+            resv = self.scheduler.meta.create_reservation(
+                request.name, request.partition,
+                list(request.node_names), request.start_time,
+                request.end_time,
+                allowed_accounts=(list(request.allowed_accounts)
+                                  if request.allowed_accounts else None),
+                denied_accounts=list(request.denied_accounts))
+        return pb.OkReply(ok=resv is not None,
+                          error="" if resv else "conflict")
+
+    def DeleteReservation(self, request, context):
+        with self._lock:
+            ok = self.scheduler.meta.delete_reservation(request.name)
+        return pb.OkReply(ok=ok, error="" if ok else "no such reservation")
+
+    # ---- internal (node plane + virtual time) ----
+
+    def CranedRegister(self, request, context):
+        with self._lock:
+            meta = self.scheduler.meta
+            if request.name in meta._name_to_id:
+                node = meta.node_by_name(request.name)
+            else:
+                node = meta.add_node(
+                    request.name,
+                    meta.layout.encode(
+                        cpu=request.total.cpu,
+                        mem_bytes=request.total.mem_bytes,
+                        memsw_bytes=request.total.memsw_bytes,
+                        is_capacity=True),
+                    partitions=tuple(request.partitions) or ("default",))
+            meta.craned_up(node.node_id)
+            # keep the simulated plane in sync so dispatch to the new
+            # node has a craned to land on
+            if self.sim is not None and node.node_id not in \
+                    self.sim.craneds:
+                self.sim.craneds[node.node_id] = SimCraned(node.node_id)
+            return pb.CranedRegisterReply(ok=True, node_id=node.node_id)
+
+    def CranedPing(self, request, context):
+        with self._lock:
+            node = self.scheduler.meta.nodes.get(request.node_id)
+            if node is None:
+                return pb.OkReply(ok=False, error="unknown node")
+            node.alive = True
+            return pb.OkReply(ok=True)
+
+    def StepStatusChange(self, request, context):
+        with self._lock:
+            self.scheduler.step_status_change(
+                request.job_id, JobStatus(request.status),
+                request.exit_code, request.time)
+        return pb.OkReply(ok=True)
+
+    def Tick(self, request, context):
+        """Run one virtual-time cycle (advance the sim plane first)."""
+        with self._lock:
+            if self.sim is not None:
+                self.sim.advance_to(request.now)
+            started = self.scheduler.schedule_cycle(request.now)
+        return pb.TickReply(started=started, now=request.now)
+
+    # ---- lifecycle ----
+
+    _RPCS = {
+        "SubmitBatchJob": (pb.SubmitJobRequest, pb.SubmitJobReply),
+        "SubmitBatchJobs": (pb.SubmitJobsRequest, pb.SubmitJobsReply),
+        "CancelJob": (pb.JobIdRequest, pb.OkReply),
+        "HoldJob": (pb.HoldRequest, pb.OkReply),
+        "SuspendJob": (pb.JobIdRequest, pb.OkReply),
+        "ResumeJob": (pb.JobIdRequest, pb.OkReply),
+        "QueryJobsInfo": (pb.QueryJobsRequest, pb.QueryJobsReply),
+        "QueryClusterInfo": (pb.QueryClusterRequest, pb.QueryClusterReply),
+        "CreateReservation": (pb.CreateReservationRequest, pb.OkReply),
+        "DeleteReservation": (pb.NameRequest, pb.OkReply),
+        "CranedRegister": (pb.CranedRegisterRequest,
+                           pb.CranedRegisterReply),
+        "CranedPing": (pb.CranedPingRequest, pb.OkReply),
+        "StepStatusChange": (pb.StepStatusChangeRequest, pb.OkReply),
+        "Tick": (pb.TickRequest, pb.TickReply),
+    }
+
+    def _now(self) -> float:
+        return self.sim.now if (self.tick_mode and self.sim is not None) \
+            else time.time()
+
+    def start(self, address: str = "127.0.0.1:0") -> int:
+        """Start serving; returns the bound port."""
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(self, name),
+                request_deserializer=req.FromString,
+                response_serializer=reply.SerializeToString)
+            for name, (req, reply) in self._RPCS.items()
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        port = self._server.add_insecure_port(address)
+        self._server.start()
+        if not self.tick_mode:
+            self._cycle_thread = threading.Thread(
+                target=self._cycle_loop, daemon=True)
+            self._cycle_thread.start()
+        return port
+
+    def _cycle_loop(self) -> None:
+        """The 1 Hz ScheduleThread_ analog (JobScheduler.cpp:1321,1981)."""
+        while not self._stop.wait(self.cycle_interval):
+            now = time.time()
+            with self._lock:
+                if self.sim is not None:
+                    self.sim.advance_to(now)
+                self.scheduler.schedule_cycle(now)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+
+
+def serve(scheduler: JobScheduler, sim: SimCluster | None = None,
+          address: str = "127.0.0.1:0", **kw) -> tuple[CtldServer, int]:
+    server = CtldServer(scheduler, sim=sim, **kw)
+    port = server.start(address)
+    return server, port

@@ -1,0 +1,184 @@
+"""gRPC service + CLI end-to-end: submit/query/cancel/control over the
+wire against a virtual-time server with a simulated node plane
+(reference CtldGrpcServer.cpp:691-2649 + the §2.7 CLI surface)."""
+
+import pytest
+
+from cranesched_tpu import cli
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    MetaContainer,
+    SchedulerConfig,
+)
+from cranesched_tpu.rpc import CtldClient, crane_pb2 as pb, serve
+
+
+@pytest.fixture()
+def ctld():
+    meta = MetaContainer()
+    for i in range(4):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    sim = SimCluster(sched)
+    sched.dispatch = sim.dispatch
+    sched.dispatch_terminate = sim.terminate
+    sched.dispatch_suspend = sim.suspend
+    sched.dispatch_resume = sim.resume
+    server, port = serve(sched, sim=sim, tick_mode=True)
+    client = CtldClient(f"127.0.0.1:{port}")
+    yield client, server, sched, port
+    client.close()
+    server.stop()
+
+
+def job_spec(cpu=2.0, runtime=30.0, **kw):
+    return pb.JobSpec(res=pb.ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                          memsw_bytes=1 << 30),
+                      sim_runtime=runtime, **kw)
+
+
+def test_submit_tick_query_lifecycle(ctld):
+    client, server, sched, _ = ctld
+    reply = client.submit(job_spec(name="hello"))
+    assert reply.job_id == 1
+
+    tick = client.tick(0.0)
+    assert list(tick.started) == [1]
+
+    jobs = client.query_jobs().jobs
+    assert len(jobs) == 1
+    assert jobs[0].status == "Running"
+    assert jobs[0].node_names[0].startswith("cn")
+
+    client.tick(31.0)
+    jobs = client.query_jobs(include_history=True).jobs
+    assert jobs[0].status == "Completed"
+
+
+def test_submit_many_and_filters(ctld):
+    client, _, _, _ = ctld
+    specs = [job_spec(name=f"j{i}", user="alice" if i % 2 else "bob")
+             for i in range(6)]
+    replies = client.submit_many(specs).replies
+    assert [r.job_id for r in replies] == [1, 2, 3, 4, 5, 6]
+    assert len(client.query_jobs(user="alice").jobs) == 3
+
+
+def test_cancel_hold_suspend_over_wire(ctld):
+    client, _, _, _ = ctld
+    a = client.submit(job_spec(runtime=100.0)).job_id
+    b = client.submit(job_spec(runtime=100.0)).job_id
+    assert client.hold(b).ok
+    client.tick(0.0)
+    assert client.query_jobs(job_ids=[b]).jobs[0].pending_reason == "Held"
+    assert client.suspend(a).ok
+    assert client.query_jobs(job_ids=[a]).jobs[0].status == "Suspended"
+    assert client.resume(a).ok
+    assert client.cancel(a).ok
+    client.tick(1.0)
+    assert client.query_jobs(job_ids=[a],
+                             include_history=True).jobs[0].status == \
+        "Cancelled"
+
+
+def test_cluster_info_states(ctld):
+    client, _, _, _ = ctld
+    client.submit(job_spec(cpu=8.0))
+    client.tick(0.0)
+    nodes = client.query_cluster().nodes
+    assert len(nodes) == 4
+    states = {n.name: n.state for n in nodes}
+    assert sorted(states.values()) == ["ALLOC", "IDLE", "IDLE", "IDLE"] \
+        or "MIXED" in states.values()
+
+
+def test_reservation_over_wire(ctld):
+    client, _, _, _ = ctld
+    assert client.create_reservation("maint", "default", ["cn00"],
+                                     0.0, 1000.0).ok
+    # overlapping second reservation refused
+    assert not client.create_reservation("maint2", "default", ["cn00"],
+                                         10.0, 20.0).ok
+    assert client.delete_reservation("maint").ok
+
+
+def test_craned_register_and_status_change(ctld):
+    client, _, sched, _ = ctld
+    reply = client.craned_register(
+        "cn99", pb.ResourceSpec(cpu=4.0, mem_bytes=8 << 30,
+                                memsw_bytes=8 << 30))
+    assert reply.ok
+    assert sched.meta.node_by_name("cn99").alive
+    assert client.craned_ping(reply.node_id).ok
+
+
+def test_gang_and_packed_spec_over_wire(ctld):
+    client, _, _, _ = ctld
+    spec = job_spec(runtime=10.0)
+    spec.node_num = 2
+    spec.ntasks = 6
+    spec.ntasks_per_node_max = 4
+    spec.task_res.CopyFrom(pb.ResourceSpec(cpu=1.0))
+    jid = client.submit(spec).job_id
+    assert jid > 0
+    client.tick(0.0)
+    info = client.query_jobs(job_ids=[jid]).jobs[0]
+    assert info.status == "Running"
+    assert len(info.node_names) == 2
+    assert sum(info.task_layout) == 6
+
+
+# ---------------- CLI ----------------
+
+def run_cli(capsys, server_port, *argv):
+    rc = cli.main(["--server", f"127.0.0.1:{server_port}", *argv])
+    return rc, capsys.readouterr()
+
+
+def test_cli_roundtrip(ctld, capsys):
+    client, server, sched, port = ctld
+    rc, out = run_cli(capsys, port, "cbatch", "--cpu", "2",
+                      "--mem", "1G", "--job-name", "clitest",
+                      "--sim-runtime", "20")
+    assert rc == 0 and "Submitted batch job 1" in out.out
+    client.tick(0.0)
+    rc, out = run_cli(capsys, port, "cqueue")
+    assert rc == 0 and "clitest" in out.out and "Running" in out.out
+    rc, out = run_cli(capsys, port, "cinfo")
+    assert rc == 0 and "cn00" in out.out
+    client.tick(21.0)
+    rc, out = run_cli(capsys, port, "cacct")
+    assert rc == 0 and "Completed" in out.out
+
+
+def test_cli_array_and_dependency_flags(ctld, capsys):
+    client, server, sched, port = ctld
+    rc, out = run_cli(capsys, port, "cbatch", "--array", "0-3%2",
+                      "--cpu", "1", "--sim-runtime", "5")
+    assert rc == 0
+    rc, out = run_cli(capsys, port, "cbatch", "--dependency",
+                      "afterok:1", "--cpu", "1", "--sim-runtime", "5")
+    assert rc == 0
+    job = sched.job_info(2)
+    assert job.spec.dependencies[0].job_id == 1
+    parent = sched.job_info(1)
+    assert parent.spec.array.max_concurrent == 2
+
+
+def test_cli_cancel_and_control(ctld, capsys):
+    client, server, sched, port = ctld
+    run_cli(capsys, port, "cbatch", "--cpu", "1", "--sim-runtime", "100")
+    client.tick(0.0)
+    rc, _ = run_cli(capsys, port, "ccontrol", "suspend", "1")
+    assert rc == 0
+    rc, _ = run_cli(capsys, port, "ccontrol", "resume", "1")
+    assert rc == 0
+    rc, _ = run_cli(capsys, port, "ccancel", "1")
+    assert rc == 0
+    rc, out = run_cli(capsys, port, "ccancel", "999")
+    assert rc == 1 and "no such job" in out.err
