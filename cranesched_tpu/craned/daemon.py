@@ -1,0 +1,294 @@
+"""The real craned: node daemon running actual job steps.
+
+Mirrors the reference's node plane (reference: src/Craned/Core/ —
+CtldClient registration/ping FSM CtldClient.h:35-90, JobManager
+JobManager.h:94-358, StepInstance fork/exec + pipe handshake
+StepInstance.cpp:146-201; supervisor spawning Supervisor.cpp:34):
+
+* registration FSM: DISCONNECTED → REGISTERING → READY, driven by a ping
+  thread (reference kCranedPingIntervalSec = 10, PublicHeader.h:145);
+  ping failures reconnect and re-register.
+* a gRPC ``Craned`` service receives pushed work from ctld
+  (ExecuteStep/TerminateStep/SuspendStep/ResumeStep — reference
+  CranedServer.cpp:32-577).
+* each step spawns a REAL ``csupervisor`` process with the stdin pipe
+  handshake (init JSON → READY → GO), optional cgroup-v2 attachment, and
+  a watcher thread that turns the supervisor's exit report into a
+  StepStatusChange upcall to ctld.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import subprocess
+import sys
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from cranesched_tpu.craned.cgroup import CgroupV2
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.client import CtldClient
+from cranesched_tpu.rpc.consts import CRANED_SERVICE
+
+
+class CranedState(enum.Enum):
+    """Reference CtldClientStateMachine states (CtldClient.h:90)."""
+
+    DISCONNECTED = "Disconnected"
+    REGISTERING = "Registering"
+    READY = "Ready"
+
+
+class _Step:
+    def __init__(self, job_id: int, proc: subprocess.Popen):
+        self.job_id = job_id
+        self.proc = proc
+        self.cancelled = False
+
+
+class CranedDaemon:
+    def __init__(self, name: str, ctld_address: str,
+                 cpu: float = 8.0, mem_bytes: int = 16 << 30,
+                 partitions=("default",), workdir: str = "/tmp",
+                 ping_interval: float = 5.0,
+                 cgroup_root: str = "/sys/fs/cgroup"):
+        self.name = name
+        self.ctld_address = ctld_address
+        self.cpu = cpu
+        self.mem_bytes = mem_bytes
+        self.partitions = tuple(partitions)
+        self.workdir = workdir
+        self.ping_interval = ping_interval
+        self.state = CranedState.DISCONNECTED
+        self.node_id: int | None = None
+        self.cgroups = CgroupV2(cgroup_root)
+        self._ctld = CtldClient(ctld_address, timeout=10.0)
+        self._steps: dict[int, _Step] = {}
+        # kills that arrived before (or during) the step's spawn
+        # handshake — applied if the step registers within the TTL, then
+        # expired so a future re-dispatch of the same job id survives
+        self._pending_kills: dict[int, float] = {}
+        self._pending_kill_ttl = 30.0
+        self._lock = threading.Lock()
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+        self.address = ""
+
+    # ---- the Craned service (ctld -> craned push) ----
+
+    def ExecuteStep(self, request, context):
+        try:
+            self._spawn_step(request)
+            return pb.OkReply(ok=True)
+        except Exception as exc:  # report, never crash the RPC
+            return pb.OkReply(ok=False, error=str(exc))
+
+    def TerminateStep(self, request, context):
+        with self._lock:
+            step = self._steps.get(request.job_id)
+            if step is None:
+                # the kill may have raced an in-flight ExecuteStep
+                # handshake: remember it and apply at registration
+                self._pending_kills[request.job_id] = time.time()
+                return pb.OkReply(ok=True)
+            step.cancelled = True
+        self._send_verb(step, "TERM")
+        return pb.OkReply(ok=True)
+
+    def SuspendStep(self, request, context):
+        return self._freeze(request.job_id, True)
+
+    def ResumeStep(self, request, context):
+        return self._freeze(request.job_id, False)
+
+    def _freeze(self, job_id: int, frozen: bool):
+        with self._lock:
+            step = self._steps.get(job_id)
+        if step is None:
+            return pb.OkReply(ok=False, error="no such step")
+        # cgroup freezer when available, else signal the child group
+        if not self.cgroups.freeze(job_id, frozen):
+            self._send_verb(step, "STOP" if frozen else "CONT")
+        return pb.OkReply(ok=True)
+
+    def _send_verb(self, step: _Step, verb: str) -> None:
+        try:
+            step.proc.stdin.write(f"{verb}\n".encode())
+            step.proc.stdin.flush()
+        except (BrokenPipeError, ValueError, OSError):
+            pass
+
+    # ---- step spawning (StepInstance::SpawnSupervisor analog) ----
+
+    def _spawn_step(self, request) -> None:
+        job_id = request.job_id
+        spec = request.spec
+        procs_path = self.cgroups.create(
+            job_id, cpu=spec.res.cpu, mem_bytes=spec.res.mem_bytes,
+            memsw_bytes=spec.res.memsw_bytes)
+        # the supervisor must import this package regardless of workdir
+        import cranesched_tpu
+        import os
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(cranesched_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cranesched_tpu.craned.supervisor"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            cwd=self.workdir, env=env)
+        init = dict(
+            job_id=job_id, script=spec.script,
+            output_path=spec.output_path,
+            time_limit=spec.time_limit,
+            env={"CRANE_JOB_NAME": spec.name,
+                 "CRANE_JOB_NODELIST": self.name},
+            cgroup_procs=procs_path)
+        proc.stdin.write((json.dumps(init) + "\n").encode())
+        proc.stdin.flush()
+        ready = proc.stdout.readline().strip()
+        if ready != b"READY":
+            proc.kill()
+            raise RuntimeError(f"supervisor handshake failed: {ready!r}")
+        proc.stdin.write(b"GO\n")
+        proc.stdin.flush()
+        step = _Step(job_id, proc)
+        with self._lock:
+            self._steps[job_id] = step
+            stamp = self._pending_kills.pop(job_id, None)
+            killed_already = (stamp is not None and
+                              time.time() - stamp < self._pending_kill_ttl)
+        if killed_already:
+            step.cancelled = True
+            self._send_verb(step, "TERM")
+        threading.Thread(target=self._watch_step, args=(step,),
+                         daemon=True).start()
+
+    def _watch_step(self, step: _Step) -> None:
+        """SIGCHLD/reporting path (supervisor exit -> StepStatusChange)."""
+        report = step.proc.stdout.readline().strip().decode()
+        step.proc.wait()
+        with self._lock:
+            self._steps.pop(step.job_id, None)
+        self.cgroups.destroy(step.job_id)
+        if step.cancelled or report == "KILLED":
+            status, code = "Cancelled", 130
+        elif report == "TIMEOUT":
+            status, code = "ExceedTimeLimit", 124
+        elif report.startswith("EXIT "):
+            code = int(report.split()[1])
+            status = "Completed" if code == 0 else "Failed"
+        else:  # supervisor died without a report
+            status, code = "Failed", 255
+        try:
+            self._ctld.step_status_change(step.job_id, status, code,
+                                          time.time(),
+                                          node_id=self.node_id
+                                          if self.node_id is not None
+                                          else -1)
+        except (grpc.RpcError, ValueError):
+            pass  # ctld down / client closed: the ping timeout + WAL
+                  # reconcile at re-registration
+
+    # ---- lifecycle: serve + register + ping ----
+
+    _RPCS = {
+        "ExecuteStep": (pb.ExecuteStepRequest, pb.OkReply),
+        "TerminateStep": (pb.JobIdRequest, pb.OkReply),
+        "SuspendStep": (pb.JobIdRequest, pb.OkReply),
+        "ResumeStep": (pb.JobIdRequest, pb.OkReply),
+    }
+
+    def start(self, address: str = "127.0.0.1:0") -> int:
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(self, name),
+                request_deserializer=req.FromString,
+                response_serializer=reply.SerializeToString)
+            for name, (req, reply) in self._RPCS.items()
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(CRANED_SERVICE,
+                                                  handlers),))
+        port = self._server.add_insecure_port(address)
+        self._server.start()
+        self.address = f"127.0.0.1:{port}"
+        threading.Thread(target=self._fsm_loop, daemon=True).start()
+        return port
+
+    def _register(self) -> bool:
+        try:
+            reply = self._ctld._call(
+                "CranedRegister",
+                pb.CranedRegisterRequest(
+                    name=self.name,
+                    total=pb.ResourceSpec(cpu=self.cpu,
+                                          mem_bytes=self.mem_bytes,
+                                          memsw_bytes=self.mem_bytes),
+                    partitions=list(self.partitions),
+                    address=self.address),
+                pb.CranedRegisterReply)
+        except grpc.RpcError:
+            return False
+        if reply.ok:
+            self.node_id = reply.node_id
+            # kill stale local steps ctld no longer expects (reference
+            # Configure expectations: ctld tells the craned what should
+            # be running; anything else died with our old registration)
+            expected = set(reply.expected_jobs)
+            with self._lock:
+                stale = [s for j, s in self._steps.items()
+                         if j not in expected]
+            for step in stale:
+                step.cancelled = True
+                self._send_verb(step, "TERM")
+            return True
+        return False
+
+    def _fsm_loop(self) -> None:
+        """Registration/ping FSM (reference CtldClient.h:90:
+        Disconnected → ... → Ready; ping misses reconnect)."""
+        while not self._stop.is_set():
+            if self.state != CranedState.READY:
+                self.state = CranedState.REGISTERING
+                if self._register():
+                    self.state = CranedState.READY
+                else:
+                    self.state = CranedState.DISCONNECTED
+                    self._stop.wait(self.ping_interval)
+                    continue
+            if self._stop.wait(self.ping_interval):
+                return
+            try:
+                ok = self._ctld.craned_ping(self.node_id).ok
+            except grpc.RpcError:
+                ok = False
+            if not ok:
+                self.state = CranedState.DISCONNECTED
+
+    def stop(self, graceful: bool = True) -> None:
+        """graceful=False mimics a node crash: no kills, no reports —
+        ctld must detect the death via missed pings."""
+        self._stop.set()
+        if not graceful:
+            self._ctld.close()   # closed first: no report can escape
+        with self._lock:
+            steps = list(self._steps.values())
+        for step in steps:
+            if graceful:
+                self._send_verb(step, "TERM")
+            else:
+                step.proc.kill()  # crash simulation: the user workload
+                                  # is deliberately orphaned
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+        if graceful:
+            self._ctld.close()

@@ -1,0 +1,123 @@
+"""csupervisor: one process per job step (run as
+``python -m cranesched_tpu.craned.supervisor``).
+
+Mirrors the reference's Supervisor process (reference:
+src/Craned/Supervisor/Supervisor.cpp:34 InitFromStdin — config arrives on
+the stdin pipe after a fork handshake; TaskManager owns the user process,
+its termination/deadline timers and status propagation,
+TaskManager.h:541-784).  Protocol here:
+
+  stdin   <- one JSON line: {job_id, script, output_path, time_limit,
+             env, cgroup_procs?}
+  stdout  -> "READY"                 (handshake: ChildProcessReady analog)
+  stdin   <- "GO" | control verbs: "TERM", "STOP", "CONT"
+  stdout  -> "EXIT <code>" | "TIMEOUT" | "KILLED"
+
+The user command runs as ``bash -c script`` in its own session so control
+verbs signal the whole process group without touching the supervisor
+(the reference freezes/kills via cgroups for the same isolation).
+Suspended wall time extends the deadline (time-limit credit,
+reference JobScheduler.cpp:118-126).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+def _substitute(pattern: str, job_id: int) -> str:
+    return pattern.replace("%j", str(job_id))
+
+
+def main() -> int:
+    init = json.loads(sys.stdin.readline())
+    job_id = init["job_id"]
+    script = init.get("script") or "true"
+    time_limit = float(init.get("time_limit") or 0) or None
+    env = dict(os.environ, **(init.get("env") or {}),
+               CRANE_JOB_ID=str(job_id))
+
+    out_path = _substitute(init.get("output_path") or "/dev/null", job_id)
+    if out_path != "/dev/null":
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    out = open(out_path, "ab", buffering=0)
+
+    print("READY", flush=True)
+    go = sys.stdin.readline().strip()
+    if go != "GO":
+        return 1
+
+    child = subprocess.Popen(
+        ["bash", "-c", script], stdout=out, stderr=out, env=env,
+        start_new_session=True)
+    # optional cgroup attachment (the craned pre-created the cgroup and
+    # passed its cgroup.procs path)
+    procs_path = init.get("cgroup_procs")
+    if procs_path:
+        try:
+            with open(procs_path, "w") as fh:
+                fh.write(str(child.pid))
+        except OSError:
+            pass  # cgroupfs unavailable: resource limits best-effort
+
+    state = {"suspended_at": None, "suspended_total": 0.0,
+             "terminated": False}
+    start = time.monotonic()
+
+    def control_loop():
+        for line in sys.stdin:
+            verb = line.strip()
+            try:
+                if verb == "TERM":
+                    state["terminated"] = True
+                    os.killpg(child.pid, signal.SIGTERM)
+                    threading.Timer(
+                        5.0, lambda: child.poll() is None
+                        and os.killpg(child.pid, signal.SIGKILL)).start()
+                elif verb == "STOP":
+                    os.killpg(child.pid, signal.SIGSTOP)
+                    state["suspended_at"] = time.monotonic()
+                elif verb == "CONT":
+                    if state["suspended_at"] is not None:
+                        state["suspended_total"] += (
+                            time.monotonic() - state["suspended_at"])
+                        state["suspended_at"] = None
+                    os.killpg(child.pid, signal.SIGCONT)
+            except ProcessLookupError:
+                return
+
+    threading.Thread(target=control_loop, daemon=True).start()
+
+    while True:
+        try:
+            code = child.wait(timeout=0.2)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        if time_limit is None or state["suspended_at"] is not None:
+            continue
+        elapsed = (time.monotonic() - start) - state["suspended_total"]
+        if elapsed > time_limit:
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            child.wait()
+            print("TIMEOUT", flush=True)
+            return 0
+
+    if state["terminated"]:
+        print("KILLED", flush=True)
+    else:
+        print(f"EXIT {code}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,10 @@ class JobSpec:
     array: ArraySpec | None = None
     # named reservation to run inside (reference ResvMeta)
     reservation: str = ""
+    # batch script (run as bash -c by the supervisor) and output path
+    # pattern (%j substitutes the job id; reference batch meta)
+    script: str = ""
+    output_path: str = ""
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None
@@ -181,6 +185,10 @@ class Job:
     exit_code: int | None = None
     node_ids: list[int] = dataclasses.field(default_factory=list)
     task_layout: list[int] = dataclasses.field(default_factory=list)
+    # per-node terminal reports for multi-node jobs (real node plane):
+    # the job is terminal once every allocated node reported
+    node_reports: dict[int, tuple] = dataclasses.field(
+        default_factory=dict)
     requeue_count: int = 0
     # dependency edge state: dep job_id -> earliest satisfiable time, or
     # DEP_NEVER (event-driven, reference AddDependent /
@@ -217,6 +225,7 @@ class Job:
         self.exit_code = None
         self.node_ids = []
         self.task_layout = []
+        self.node_reports = {}
         self.alloc_cache = None
         self.requeue_count += 1
         self.priority = 0.0

@@ -55,6 +55,11 @@ class NodeMeta:
     drained: bool = False
     partitions: set[str] = dataclasses.field(default_factory=set)
     running_jobs: set[int] = dataclasses.field(default_factory=set)
+    # real node plane: craned's push address + liveness tracking
+    # (reference CranedPing every 10 s, timeout 30 s, PublicHeader.h:145)
+    address: str = ""
+    last_ping: float = 0.0
+    expect_pings: bool = False
 
     @property
     def schedulable(self) -> bool:

@@ -90,6 +90,9 @@ class SchedulerConfig:
     backfill: bool = True
     time_resolution: float = 60.0       # seconds per bucket
     time_buckets: int = 64              # horizon = resolution * buckets
+    # real node plane: a craned that misses pings for this long is down
+    # (reference kCranedTimeoutSec = 30, PublicHeader.h:146)
+    craned_timeout: float = 30.0
 
 
 @dataclasses.dataclass
@@ -343,7 +346,49 @@ class JobScheduler:
     # ------------------------------------------------------------------
 
     def step_status_change(self, job_id: int, status: JobStatus,
-                           exit_code: int, now: float) -> None:
+                           exit_code: int, now: float,
+                           node_id: int = -1) -> None:
+        """node_id >= 0 is a per-node report from a real craned; the job
+        is terminal only when every allocated node reported (or on the
+        first failure, which kills the rest).  node_id == -1 is a
+        whole-job report (simulated plane / dispatch failures)."""
+        if node_id >= 0:
+            job = self.running.get(job_id)
+            if job is None:
+                return
+            is_failure = status not in (JobStatus.COMPLETED,
+                                        JobStatus.CANCELLED)
+            had_failure = any(
+                st not in (JobStatus.COMPLETED, JobStatus.CANCELLED)
+                for st, _ in job.node_reports.values())
+            job.node_reports[node_id] = (status, exit_code)
+            if is_failure and not had_failure:
+                # first failure: kill the remaining steps; their
+                # Cancelled reports complete the set
+                self.dispatch_terminate(job_id, now)
+            if not all(n in job.node_reports for n in job.node_ids):
+                return
+            # aggregate: worst status wins (any non-complete -> that)
+            agg_status, agg_code = JobStatus.COMPLETED, 0
+            for st, code in job.node_reports.values():
+                if st != JobStatus.COMPLETED and st != JobStatus.CANCELLED:
+                    agg_status, agg_code = st, code
+                    break
+            else:
+                if any(st == JobStatus.CANCELLED
+                       for st, _ in job.node_reports.values()) and \
+                        not all(st == JobStatus.CANCELLED
+                                for st, _ in job.node_reports.values()):
+                    # mixed Cancelled (our kill) + Completed: the kill
+                    # was collateral of another node's failure... or a
+                    # user cancel; cancel_requested disambiguates
+                    agg_status = (JobStatus.CANCELLED
+                                  if job.cancel_requested
+                                  else JobStatus.COMPLETED)
+                elif all(st == JobStatus.CANCELLED
+                         for st, _ in job.node_reports.values()):
+                    agg_status, agg_code = JobStatus.CANCELLED, 130
+            status, exit_code = agg_status, agg_code
         self._status_queue.append(
             StatusChange(job_id, status, exit_code, now))
 
@@ -534,6 +579,7 @@ class JobScheduler:
         """One cycle: drain status changes, snapshot, device solve, commit,
         dispatch.  Returns the job_ids started this cycle."""
         self.process_status_changes()
+        self._check_craned_timeouts(now)
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
 
@@ -726,6 +772,15 @@ class JobScheduler:
             parent.end_time = child.end_time
             self._finalize(parent)
             self._trigger_dep_event(parent)
+
+    def _check_craned_timeouts(self, now: float) -> None:
+        """Ping-miss failure detection (reference ping FSM + CranedDown,
+        SURVEY §3.5): real craneds that stopped pinging are declared dead
+        and their jobs requeued."""
+        for node in self.meta.nodes.values():
+            if (node.alive and node.expect_pings
+                    and now - node.last_ping > self.config.craned_timeout):
+                self.on_craned_down(node.node_id, now)
 
     def _pending_candidates(self, now: float) -> list[Job]:
         """Skip held / future-begin-time jobs (cpp:1374-1413); dependency

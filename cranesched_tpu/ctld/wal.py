@@ -98,6 +98,8 @@ def _job_to_dict(job: Job) -> dict:
         "exit_code": job.exit_code,
         "node_ids": job.node_ids,
         "task_layout": job.task_layout,
+        "node_reports": {str(k): [v[0].name, v[1]]
+                         for k, v in job.node_reports.items()},
         "requeue_count": job.requeue_count,
         "dep_state": {str(k): (None if v is None
                                else ("never" if v == float("inf") else v))
@@ -130,6 +132,8 @@ def _job_from_dict(d: dict) -> Job:
         exit_code=d["exit_code"],
         node_ids=list(d["node_ids"]),
         task_layout=list(d.get("task_layout") or ()),
+        node_reports={int(k): (JobStatus[v[0]], v[1])
+                      for k, v in (d.get("node_reports") or {}).items()},
         requeue_count=d["requeue_count"],
         dep_state={int(k): (None if v is None
                             else (float("inf") if v == "never" else v))

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-FLOAT_MAX = jnp.float32(3.4e38)
+FLOAT_MAX = 3.4e38  # plain float: keep module import backend-free
 
 
 @struct.dataclass

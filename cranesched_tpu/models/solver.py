@@ -57,7 +57,9 @@ from cranesched_tpu.ops.resources import DIM_CPU
 # node per cycle — beyond the reference's own per-node job cap (1000,
 # JobScheduler.h:269).
 COST_SCALE = 16
-COST_INF = jnp.int32(2**31 - 1)  # "infeasible" sentinel cost
+COST_INF = 2**31 - 1  # "infeasible" sentinel cost (int32 max; a plain
+                      # Python int so importing this module never
+                      # initializes a JAX backend)
 
 
 def quantized_dcost(time_limit, req_cpu, cpu_total_f32):

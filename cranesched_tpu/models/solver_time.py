@@ -59,7 +59,7 @@ from cranesched_tpu.models.solver import (
 )
 
 # start_bucket value for jobs that could not be scheduled in the window
-NO_START = jnp.int32(2**30)
+NO_START = 2**30  # plain int: keep module import backend-free
 
 
 @struct.dataclass
@@ -183,7 +183,8 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
     counts = jnp.sum(ok, axis=0, dtype=jnp.int32)                 # [T]
     can = counts >= node_num
     any_can = jnp.any(can)
-    s = jnp.where(any_can, jnp.argmax(can).astype(jnp.int32), NO_START)
+    s = jnp.where(any_can, jnp.argmax(can).astype(jnp.int32),
+                  jnp.int32(NO_START))
 
     num_eligible = jnp.sum(eligible, dtype=jnp.int32)
     placed_ok, reason = decide_job(

@@ -103,12 +103,13 @@ class CtldClient:
                           pb.CranedPingRequest(node_id=node_id),
                           pb.OkReply)
 
-    def step_status_change(self, job_id, status, exit_code,
-                           time) -> pb.OkReply:
+    def step_status_change(self, job_id, status, exit_code, time,
+                           node_id: int = -1) -> pb.OkReply:
         return self._call(
             "StepStatusChange",
             pb.StepStatusChangeRequest(job_id=job_id, status=status,
-                                       exit_code=exit_code, time=time),
+                                       exit_code=exit_code, time=time,
+                                       node_id=node_id),
             pb.OkReply)
 
     def tick(self, now: float) -> pb.TickReply:

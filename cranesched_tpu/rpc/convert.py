@@ -75,9 +75,43 @@ def spec_from_pb(msg) -> JobSpec:
         deps_is_or=msg.deps_is_or,
         array=array,
         reservation=msg.reservation,
+        script=msg.script,
+        output_path=msg.output_path,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
+
+
+def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
+    msg = pb.JobSpec(
+        name=spec.name, user=spec.user, account=spec.account,
+        partition=spec.partition, res=res_to_pb(spec.res),
+        node_num=spec.node_num,
+        ntasks=spec.ntasks or 0,
+        ntasks_per_node_min=spec.ntasks_per_node_min,
+        ntasks_per_node_max=spec.ntasks_per_node_max,
+        exclusive=spec.exclusive, time_limit=spec.time_limit,
+        qos=spec.qos, qos_priority=spec.qos_priority, held=spec.held,
+        include_nodes=list(spec.include_nodes),
+        exclude_nodes=list(spec.exclude_nodes),
+        begin_time=spec.begin_time or 0.0,
+        requeue_if_failed=spec.requeue_if_failed,
+        deps_is_or=spec.deps_is_or,
+        reservation=spec.reservation,
+        script=spec.script, output_path=spec.output_path,
+        sim_runtime=spec.sim_runtime or 0.0,
+        sim_exit_code=spec.sim_exit_code)
+    if spec.task_res is not None:
+        msg.task_res.CopyFrom(res_to_pb(spec.task_res))
+    for dep in spec.dependencies:
+        msg.dependencies.add(job_id=dep.job_id, type=dep.type.value,
+                             delay_seconds=dep.delay_seconds)
+    if spec.array is not None:
+        msg.array.CopyFrom(pb.ArraySpec(
+            start=spec.array.start, end=spec.array.end,
+            stride=spec.array.stride,
+            max_concurrent=spec.array.max_concurrent))
+    return msg
 
 
 def job_to_pb(job: Job, node_names) -> pb.JobInfo:

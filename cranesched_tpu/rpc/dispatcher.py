@@ -1,0 +1,148 @@
+"""Per-node push dispatch: the reference's CranedKeeper + scheduler
+fan-out (reference: src/CraneCtld/RpcService/CranedKeeper.h:74-107 — one
+stub per craned on shared channels; AllocJobs/AllocSteps fan-out with a
+thread pool + latch, JobScheduler.cpp:1732-1839).
+
+Wire-up::
+
+    dispatcher = GrpcDispatcher(scheduler)
+    scheduler.dispatch = dispatcher.dispatch
+    scheduler.dispatch_terminate = dispatcher.terminate
+    scheduler.dispatch_suspend = dispatcher.suspend
+    scheduler.dispatch_resume = dispatcher.resume
+    server = CtldServer(scheduler, dispatcher=dispatcher)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from cranesched_tpu.ctld.defs import Job, JobStatus
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.consts import CRANED_SERVICE
+from cranesched_tpu.rpc.convert import spec_to_pb
+
+
+class _CranedStub:
+    """One channel per craned (reference CranedStub)."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._stubs = {}
+
+    def call(self, name, request, reply_cls=pb.OkReply):
+        stub = self._stubs.get(name)
+        if stub is None:
+            stub = self._channel.unary_unary(
+                f"/{CRANED_SERVICE}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=reply_cls.FromString)
+            self._stubs[name] = stub
+        return stub(request, timeout=self.timeout)
+
+    def close(self):
+        self._channel.close()
+
+
+class GrpcDispatcher:
+    def __init__(self, scheduler, max_workers: int = 8):
+        self.scheduler = scheduler
+        self._stubs: dict[int, _CranedStub] = {}
+        self._lock = threading.Lock()
+        self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
+
+    def node_registered(self, node_id: int, address: str) -> None:
+        with self._lock:
+            old = self._stubs.get(node_id)
+            if old is not None and old.address != address:
+                old.close()
+                old = None
+            if old is None:
+                self._stubs[node_id] = _CranedStub(address)
+
+    def _stub(self, node_id: int) -> _CranedStub | None:
+        with self._lock:
+            return self._stubs.get(node_id)
+
+    # ---- the dispatch seam ----
+
+    def dispatch(self, job: Job, node_ids: list[int]) -> None:
+        """ExecuteStep fan-out, ASYNCHRONOUS: the caller holds the ctld
+        lock, so pushes must not block on craned RPCs (an unreachable
+        craned would stall pings from healthy nodes and cascade false
+        CranedDown events).  A failed push fails the job via the normal
+        status-change path (the reference frees resources and marks
+        Failed on dispatch errors, JobScheduler.cpp:1908-1967)."""
+        spec_pb = spec_to_pb(job.spec)
+        tasks = job.task_layout or [1] * len(node_ids)
+
+        def push(node_id, ntasks):
+            stub = self._stub(node_id)
+            if stub is None:
+                return f"node {node_id} has no stub"
+            try:
+                reply = stub.call("ExecuteStep", pb.ExecuteStepRequest(
+                    job_id=job.job_id, spec=spec_pb,
+                    tasks_on_node=ntasks, now=time.time()))
+                return "" if reply.ok else reply.error
+            except grpc.RpcError as exc:
+                return f"push to node {node_id} failed: {exc.code()}"
+
+        def fan_out():
+            errors = [e for e in map(push, node_ids,
+                                     tasks[: len(node_ids)]) if e]
+            if errors:
+                # kill any step that did start, then report failure
+                for node_id in node_ids:
+                    self._try_call(node_id, "TerminateStep",
+                                   pb.JobIdRequest(job_id=job.job_id))
+                self.scheduler.step_status_change(
+                    job.job_id, JobStatus.FAILED, 254, time.time())
+
+        self._pool.submit(fan_out)
+
+    def terminate(self, job_id: int, now: float) -> None:
+        nodes = self._job_nodes(job_id)
+        self._pool.submit(lambda: [
+            self._try_call(n, "TerminateStep",
+                           pb.JobIdRequest(job_id=job_id))
+            for n in nodes])
+
+    def suspend(self, job_id: int, now: float) -> None:
+        nodes = self._job_nodes(job_id)
+        self._pool.submit(lambda: [
+            self._try_call(n, "SuspendStep",
+                           pb.JobIdRequest(job_id=job_id))
+            for n in nodes])
+
+    def resume(self, job_id: int, now: float) -> None:
+        nodes = self._job_nodes(job_id)
+        self._pool.submit(lambda: [
+            self._try_call(n, "ResumeStep",
+                           pb.JobIdRequest(job_id=job_id))
+            for n in nodes])
+
+    def _job_nodes(self, job_id: int) -> list[int]:
+        job = self.scheduler.running.get(job_id)
+        return list(job.node_ids) if job is not None else []
+
+    def _try_call(self, node_id, name, request) -> None:
+        stub = self._stub(node_id)
+        if stub is None:
+            return
+        try:
+            stub.call(name, request)
+        except grpc.RpcError:
+            pass  # the ping timeout will reap a dead node
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        with self._lock:
+            for stub in self._stubs.values():
+                stub.close()

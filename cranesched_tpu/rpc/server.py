@@ -50,9 +50,13 @@ class CtldServer:
 
     def __init__(self, scheduler: JobScheduler,
                  sim: SimCluster | None = None,
-                 cycle_interval: float = 1.0, tick_mode: bool = False):
+                 cycle_interval: float = 1.0, tick_mode: bool = False,
+                 dispatcher=None):
         self.scheduler = scheduler
         self.sim = sim
+        # real node plane: per-node push stubs (wired into the
+        # scheduler's dispatch seam by the caller)
+        self.dispatcher = dispatcher
         self.cycle_interval = cycle_interval
         self.tick_mode = tick_mode
         self._lock = threading.Lock()
@@ -178,26 +182,47 @@ class CtldServer:
                         is_capacity=True),
                     partitions=tuple(request.partitions) or ("default",))
             meta.craned_up(node.node_id)
+            if request.address:
+                # a REAL craned: remember its push address and expect
+                # pings (missed pings -> CranedDown in the cycle)
+                node.address = request.address
+                node.expect_pings = True
+                node.last_ping = self._now()
+                if self.dispatcher is not None:
+                    self.dispatcher.node_registered(node.node_id,
+                                                    request.address)
             # keep the simulated plane in sync so dispatch to the new
             # node has a craned to land on
-            if self.sim is not None and node.node_id not in \
+            elif self.sim is not None and node.node_id not in \
                     self.sim.craneds:
                 self.sim.craneds[node.node_id] = SimCraned(node.node_id)
-            return pb.CranedRegisterReply(ok=True, node_id=node.node_id)
+            # tell the craned which steps ctld still expects on it;
+            # anything else running locally is stale (Configure flow)
+            expected = [jid for jid, job in
+                        self.scheduler.running.items()
+                        if node.node_id in job.node_ids]
+            return pb.CranedRegisterReply(ok=True, node_id=node.node_id,
+                                          expected_jobs=expected)
 
     def CranedPing(self, request, context):
         with self._lock:
             node = self.scheduler.meta.nodes.get(request.node_id)
             if node is None:
                 return pb.OkReply(ok=False, error="unknown node")
-            node.alive = True
+            if not node.alive and node.expect_pings:
+                # ctld declared this node down (its jobs were requeued):
+                # a bare ping cannot resurrect it — force the craned back
+                # through registration so stale steps get reconciled
+                return pb.OkReply(ok=False, error="re-register")
+            node.last_ping = self._now()
             return pb.OkReply(ok=True)
 
     def StepStatusChange(self, request, context):
         with self._lock:
             self.scheduler.step_status_change(
                 request.job_id, JobStatus(request.status),
-                request.exit_code, request.time)
+                request.exit_code, request.time,
+                node_id=request.node_id)
         return pb.OkReply(ok=True)
 
     def Tick(self, request, context):
