@@ -68,11 +68,12 @@ class CranedDaemon:
         self.cgroups = CgroupV2(cgroup_root)
         self._ctld = CtldClient(ctld_address, timeout=10.0)
         self._steps: dict[int, _Step] = {}
-        # kills that arrived before (or during) the step's spawn
-        # handshake — applied if the step registers within the TTL, then
-        # expired so a future re-dispatch of the same job id survives
-        self._pending_kills: dict[int, float] = {}
-        self._pending_kill_ttl = 30.0
+        # kills that race an in-flight spawn handshake: recorded only
+        # while a spawn for that job is actually in progress (a kill for
+        # a step that already finished is a no-op and must NOT poison a
+        # future re-dispatch of the same job id)
+        self._spawning: set[int] = set()
+        self._pending_kills: set[int] = set()
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
@@ -86,14 +87,21 @@ class CranedDaemon:
             return pb.OkReply(ok=True)
         except Exception as exc:  # report, never crash the RPC
             return pb.OkReply(ok=False, error=str(exc))
+        finally:
+            with self._lock:
+                self._spawning.discard(request.job_id)
+                self._pending_kills.discard(request.job_id)
 
     def TerminateStep(self, request, context):
         with self._lock:
             step = self._steps.get(request.job_id)
             if step is None:
-                # the kill may have raced an in-flight ExecuteStep
-                # handshake: remember it and apply at registration
-                self._pending_kills[request.job_id] = time.time()
+                if request.job_id in self._spawning:
+                    # the kill raced an in-flight ExecuteStep handshake:
+                    # apply it the moment the step registers
+                    self._pending_kills.add(request.job_id)
+                # else: the step already finished (or never started) —
+                # the kill is a no-op
                 return pb.OkReply(ok=True)
             step.cancelled = True
         self._send_verb(step, "TERM")
@@ -110,9 +118,15 @@ class CranedDaemon:
             step = self._steps.get(job_id)
         if step is None:
             return pb.OkReply(ok=False, error="no such step")
-        # cgroup freezer when available, else signal the child group
-        if not self.cgroups.freeze(job_id, frozen):
-            self._send_verb(step, "STOP" if frozen else "CONT")
+        # the supervisor ALWAYS gets the verb: it pauses the time-limit
+        # clock (and SIGSTOPs the group, harmless if also frozen); the
+        # cgroup freezer additionally freezes when available
+        if frozen:
+            self._send_verb(step, "STOP")
+            self.cgroups.freeze(job_id, True)
+        else:
+            self.cgroups.freeze(job_id, False)
+            self._send_verb(step, "CONT")
         return pb.OkReply(ok=True)
 
     def _send_verb(self, step: _Step, verb: str) -> None:
@@ -127,6 +141,8 @@ class CranedDaemon:
     def _spawn_step(self, request) -> None:
         job_id = request.job_id
         spec = request.spec
+        with self._lock:
+            self._spawning.add(job_id)
         procs_path = self.cgroups.create(
             job_id, cpu=spec.res.cpu, mem_bytes=spec.res.mem_bytes,
             memsw_bytes=spec.res.memsw_bytes)
@@ -161,9 +177,9 @@ class CranedDaemon:
         step = _Step(job_id, proc)
         with self._lock:
             self._steps[job_id] = step
-            stamp = self._pending_kills.pop(job_id, None)
-            killed_already = (stamp is not None and
-                              time.time() - stamp < self._pending_kill_ttl)
+            self._spawning.discard(job_id)
+            killed_already = job_id in self._pending_kills
+            self._pending_kills.discard(job_id)
         if killed_already:
             step.cancelled = True
             self._send_verb(step, "TERM")
@@ -175,8 +191,13 @@ class CranedDaemon:
         report = step.proc.stdout.readline().strip().decode()
         step.proc.wait()
         with self._lock:
-            self._steps.pop(step.job_id, None)
-        self.cgroups.destroy(step.job_id)
+            # only clean up if the registry still points at OUR step — a
+            # re-dispatched incarnation may have replaced the entry
+            mine = self._steps.get(step.job_id) is step
+            if mine:
+                self._steps.pop(step.job_id, None)
+        if mine:
+            self.cgroups.destroy(step.job_id)
         if step.cancelled or report == "KILLED":
             status, code = "Cancelled", 130
         elif report == "TIMEOUT":

@@ -77,9 +77,11 @@ def main() -> int:
                 if verb == "TERM":
                     state["terminated"] = True
                     os.killpg(child.pid, signal.SIGTERM)
-                    threading.Timer(
+                    escalate = threading.Timer(
                         5.0, lambda: child.poll() is None
-                        and os.killpg(child.pid, signal.SIGKILL)).start()
+                        and os.killpg(child.pid, signal.SIGKILL))
+                    escalate.daemon = True  # never delays supervisor exit
+                    escalate.start()
                 elif verb == "STOP":
                     os.killpg(child.pid, signal.SIGSTOP)
                     state["suspended_at"] = time.monotonic()

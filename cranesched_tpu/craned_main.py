@@ -1,0 +1,51 @@
+"""craned: the node daemon entry point (reference src/Craned/Core/
+Craned.cpp bootstrap).
+
+    python -m cranesched_tpu.craned_main --name cn01 \\
+        --ctld 127.0.0.1:50051 --cpu 16 --memory 64G
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="craned")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--ctld", required=True)
+    ap.add_argument("--cpu", type=float, default=8.0)
+    ap.add_argument("--memory", default="16G")
+    ap.add_argument("--partitions", default="default")
+    ap.add_argument("--workdir", default="/tmp")
+    ap.add_argument("--listen", default="127.0.0.1:0")
+    ap.add_argument("--ping-interval", type=float, default=5.0)
+    ap.add_argument("--cgroup-root", default="/sys/fs/cgroup")
+    args = ap.parse_args(argv)
+
+    from cranesched_tpu.craned.daemon import CranedDaemon
+    from cranesched_tpu.utils.config import parse_mem
+
+    daemon = CranedDaemon(
+        args.name, args.ctld, cpu=args.cpu,
+        mem_bytes=parse_mem(args.memory),
+        partitions=tuple(args.partitions.split(",")),
+        workdir=args.workdir, ping_interval=args.ping_interval,
+        cgroup_root=args.cgroup_root)
+    port = daemon.start(args.listen)
+    print(f"craned {args.name} serving on port {port}, "
+          f"registering with {args.ctld}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

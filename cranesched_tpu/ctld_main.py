@@ -1,0 +1,92 @@
+"""cranectld: the control-plane daemon entry point.
+
+Mirrors the reference's CraneCtld bootstrap (reference:
+src/CraneCtld/CraneCtld.cpp:1019-1279 — config parse, global init in
+dependency order, recovery from the embedded DB, then serve):
+
+    python -m cranesched_tpu.ctld_main -c etc/config.yaml
+    python -m cranesched_tpu.ctld_main -c etc/config.yaml --sim
+
+``--sim`` attaches the in-process simulated node plane (every configured
+node is immediately alive and runs jobs on the virtual completion queue);
+without it, nodes come alive as real craned daemons register.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cranectld")
+    ap.add_argument("--config", "-c", required=True)
+    ap.add_argument("--sim", action="store_true",
+                    help="simulated node plane (no real craneds)")
+    ap.add_argument("--listen", default="",
+                    help="override the config listen address")
+    ap.add_argument("--cycle-interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    from cranesched_tpu.craned.sim import SimCluster
+    from cranesched_tpu.ctld.wal import WriteAheadLog
+    from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+    from cranesched_tpu.rpc.server import serve
+    from cranesched_tpu.utils.config import load_config
+
+    cfg = load_config(args.config)
+    meta, scheduler = cfg.build()
+
+    # recovery before serving (reference JobScheduler::Init)
+    if cfg.wal_path:
+        os.makedirs(os.path.dirname(cfg.wal_path) or ".", exist_ok=True)
+        replayed = WriteAheadLog.replay(cfg.wal_path)
+        if replayed:
+            if args.sim:
+                for node in meta.nodes.values():
+                    node.alive = True
+            scheduler.recover(replayed, now=time.time())
+            print(f"recovered {len(replayed)} jobs from {cfg.wal_path}")
+        scheduler.wal = WriteAheadLog(cfg.wal_path)
+
+    sim = None
+    dispatcher = None
+    if args.sim:
+        for node in meta.nodes.values():
+            node.alive = True
+        sim = SimCluster(scheduler)
+        scheduler.dispatch = sim.dispatch
+        scheduler.dispatch_terminate = sim.terminate
+        scheduler.dispatch_suspend = sim.suspend
+        scheduler.dispatch_resume = sim.resume
+    else:
+        dispatcher = GrpcDispatcher(scheduler)
+        scheduler.dispatch = dispatcher.dispatch
+        scheduler.dispatch_terminate = dispatcher.terminate
+        scheduler.dispatch_suspend = dispatcher.suspend
+        scheduler.dispatch_resume = dispatcher.resume
+
+    address = args.listen or cfg.listen
+    server, port = serve(scheduler, sim=sim, address=address,
+                         cycle_interval=args.cycle_interval,
+                         dispatcher=dispatcher)
+    print(f"cranectld [{cfg.cluster_name}] listening on port {port} "
+          f"({'simulated' if args.sim else 'real'} node plane, "
+          f"{len(meta.nodes)} nodes configured)", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    if dispatcher is not None:
+        dispatcher.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

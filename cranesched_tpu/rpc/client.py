@@ -3,31 +3,24 @@ and by node daemons)."""
 
 from __future__ import annotations
 
-import grpc
-
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.consts import SERVICE
+from cranesched_tpu.rpc.stub import GrpcStub
 
 
 class CtldClient:
     def __init__(self, address: str, timeout: float = 30.0):
         self.address = address
         self.timeout = timeout
-        self._channel = grpc.insecure_channel(address)
-        self._stubs = {}
+        self._stub = GrpcStub(address, SERVICE, timeout)
+        # kept for tests that introspect the channel
+        self._channel = self._stub._channel
 
     def close(self) -> None:
-        self._channel.close()
+        self._stub.close()
 
     def _call(self, name, request, reply_cls):
-        stub = self._stubs.get(name)
-        if stub is None:
-            stub = self._channel.unary_unary(
-                f"/{SERVICE}/{name}",
-                request_serializer=lambda m: m.SerializeToString(),
-                response_deserializer=reply_cls.FromString)
-            self._stubs[name] = stub
-        return stub(request, timeout=self.timeout)
+        return self._stub.call(name, request, reply_cls)
 
     # ---- external ----
 

@@ -25,29 +25,17 @@ from cranesched_tpu.ctld.defs import Job, JobStatus
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.consts import CRANED_SERVICE
 from cranesched_tpu.rpc.convert import spec_to_pb
+from cranesched_tpu.rpc.stub import GrpcStub
 
 
-class _CranedStub:
+class _CranedStub(GrpcStub):
     """One channel per craned (reference CranedStub)."""
 
     def __init__(self, address: str, timeout: float = 10.0):
-        self.address = address
-        self.timeout = timeout
-        self._channel = grpc.insecure_channel(address)
-        self._stubs = {}
+        super().__init__(address, CRANED_SERVICE, timeout)
 
     def call(self, name, request, reply_cls=pb.OkReply):
-        stub = self._stubs.get(name)
-        if stub is None:
-            stub = self._channel.unary_unary(
-                f"/{CRANED_SERVICE}/{name}",
-                request_serializer=lambda m: m.SerializeToString(),
-                response_deserializer=reply_cls.FromString)
-            self._stubs[name] = stub
-        return stub(request, timeout=self.timeout)
-
-    def close(self):
-        self._channel.close()
+        return super().call(name, request, reply_cls)
 
 
 class GrpcDispatcher:

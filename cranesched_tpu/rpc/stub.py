@@ -1,0 +1,29 @@
+"""Generic unary-unary gRPC stub over an insecure channel with a lazy
+per-method cache — the single transport plumbing shared by the CLI/ctld
+client and the ctld->craned dispatcher."""
+
+from __future__ import annotations
+
+import grpc
+
+
+class GrpcStub:
+    def __init__(self, address: str, service: str, timeout: float = 30.0):
+        self.address = address
+        self.service = service
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._stubs = {}
+
+    def call(self, name, request, reply_cls):
+        stub = self._stubs.get(name)
+        if stub is None:
+            stub = self._channel.unary_unary(
+                f"/{self.service}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=reply_cls.FromString)
+            self._stubs[name] = stub
+        return stub(request, timeout=self.timeout)
+
+    def close(self) -> None:
+        self._channel.close()

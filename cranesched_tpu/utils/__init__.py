@@ -1,0 +1,16 @@
+"""Shared host utilities: hostlist grammar, config loading, and the
+ctypes bridge to the native C++ library (native/crane_native.cpp)."""
+
+from cranesched_tpu.utils.hostlist import (
+    compress_hostlist,
+    parse_hostlist,
+)
+
+__all__ = ["compress_hostlist", "parse_hostlist", "load_config"]
+
+
+def __getattr__(name):
+    if name == "load_config":
+        from cranesched_tpu.utils.config import load_config
+        return load_config
+    raise AttributeError(name)

@@ -1,0 +1,151 @@
+"""YAML cluster configuration (reference etc/config.yaml → Ctld::Config,
+CtldPublicDefs.h:92-258): node inventory with hostlist expressions,
+partitions with priorities and ACLs, priority weights, scheduler knobs,
+WAL path, and the listen address.  ``build()`` turns a parsed config into
+live control-plane objects."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+import yaml
+
+from cranesched_tpu.utils.hostlist import parse_hostlist
+
+_MEM = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_mem(value) -> int:
+    if isinstance(value, (int, float)):
+        return int(value)
+    text = str(value).strip().lower().removesuffix("b")
+    if text and text[-1] in _MEM:
+        return int(float(text[:-1]) * _MEM[text[-1]])
+    return int(text)
+
+
+def parse_max_age(value) -> int:
+    """Reference PriorityMaxAge formats (CraneCtld.cpp:327-364):
+    "day-hour", "hour:minute:second", "minute", plain seconds."""
+    text = str(value).strip()
+    if re.fullmatch(r"\d+", text):
+        return int(text) * 60  # bare number = minutes (reference :352)
+    m = re.fullmatch(r"(\d+)-(\d+)", text)
+    if m:
+        return int(m.group(1)) * 86400 + int(m.group(2)) * 3600
+    m = re.fullmatch(r"(\d+):(\d+):(\d+)", text)
+    if m:
+        return (int(m.group(1)) * 3600 + int(m.group(2)) * 60
+                + int(m.group(3)))
+    raise ValueError(f"bad MaxAge {value!r}")
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    names: list[str]
+    cpu: float
+    mem_bytes: int
+    partitions: list[str]
+
+
+@dataclasses.dataclass
+class PartitionConfig:
+    name: str
+    priority: int = 0
+    allowed_accounts: list[str] | None = None
+    denied_accounts: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class CraneConfig:
+    cluster_name: str = "crane"
+    listen: str = "127.0.0.1:50051"
+    wal_path: str = ""
+    nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
+    partitions: list[PartitionConfig] = dataclasses.field(
+        default_factory=list)
+    scheduler: dict[str, Any] = dataclasses.field(default_factory=dict)
+    priority: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def build(self):
+        """-> (MetaContainer, JobScheduler); nodes start down until their
+        craneds register (pass mark_alive=True for simulated planes)."""
+        from cranesched_tpu.ctld.meta import MetaContainer
+        from cranesched_tpu.ctld.scheduler import (
+            JobScheduler, SchedulerConfig)
+        from cranesched_tpu.models.priority import PriorityWeights
+
+        meta = MetaContainer()
+        for part in self.partitions:
+            meta.add_partition(
+                part.name, priority=part.priority,
+                allowed_accounts=part.allowed_accounts,
+                denied_accounts=part.denied_accounts)
+        for node_cfg in self.nodes:
+            for name in node_cfg.names:
+                meta.add_node(
+                    name,
+                    meta.layout.encode(cpu=node_cfg.cpu,
+                                       mem_bytes=node_cfg.mem_bytes,
+                                       memsw_bytes=node_cfg.mem_bytes,
+                                       is_capacity=True),
+                    partitions=tuple(node_cfg.partitions))
+
+        pr = self.priority
+        weights = PriorityWeights(
+            age=float(pr.get("WeightAge", 500)),
+            partition=float(pr.get("WeightPartition", 1000)),
+            job_size=float(pr.get("WeightJobSize", 0)),
+            fair_share=float(pr.get("WeightFairShare", 10000)),
+            qos=float(pr.get("WeightQoS", 1000000)),
+            favor_small=bool(pr.get("FavorSmall", True)),
+            max_age=parse_max_age(pr.get("MaxAge", "14-0")))
+        sc = self.scheduler
+        config = SchedulerConfig(
+            schedule_batch_size=int(sc.get("ScheduledBatchSize", 100000)),
+            pending_queue_max_size=int(sc.get("PendingQueueMaxSize",
+                                              900000)),
+            max_nodes_per_job=int(sc.get("MaxNodesPerJob", 8)),
+            priority_type=("basic" if str(pr.get("Type", "multifactor"))
+                           .endswith("basic") else "multifactor"),
+            priority_weights=weights,
+            backfill=bool(sc.get("Backfill", True)),
+            time_resolution=float(sc.get("TimeResolutionSec", 60)),
+            time_buckets=int(sc.get("TimeBuckets", 64)),
+            craned_timeout=float(sc.get("CranedTimeoutSec", 30)))
+        scheduler = JobScheduler(meta, config)
+        return meta, scheduler
+
+
+def load_config(path: str) -> CraneConfig:
+    with open(path, encoding="utf-8") as fh:
+        raw = yaml.safe_load(fh) or {}
+
+    nodes = []
+    for entry in raw.get("Nodes", []):
+        nodes.append(NodeConfig(
+            names=parse_hostlist(str(entry["name"])),
+            cpu=float(entry.get("cpu", 1)),
+            mem_bytes=parse_mem(entry.get("memory", 0)),
+            partitions=[str(p) for p in entry.get("partitions",
+                                                  ["default"])]))
+    partitions = []
+    for entry in raw.get("Partitions", []):
+        partitions.append(PartitionConfig(
+            name=str(entry["name"]),
+            priority=int(entry.get("priority", 0)),
+            allowed_accounts=entry.get("AllowedAccounts"),
+            denied_accounts=entry.get("DeniedAccounts", [])))
+    if not partitions:
+        partitions = [PartitionConfig(name="default")]
+
+    return CraneConfig(
+        cluster_name=str(raw.get("ClusterName", "crane")),
+        listen=str(raw.get("Listen", "127.0.0.1:50051")),
+        wal_path=str(raw.get("Wal", "") or ""),
+        nodes=nodes,
+        partitions=partitions,
+        scheduler=raw.get("Scheduler", {}) or {},
+        priority=raw.get("Priority", {}) or {})

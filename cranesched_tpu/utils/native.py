@@ -1,0 +1,105 @@
+"""ctypes bridge to the native C++ library.
+
+Builds ``native/crane_native.cpp`` on first use (g++ is baked into the
+image; ~1 s) and caches the .so next to the source.  Every entry point
+has a pure-Python twin, so environments without a toolchain still work —
+``available()`` tells callers which path they got.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "crane_native.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libcrane_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    """The loaded CDLL, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.crane_parse_hostlist.restype = ctypes.c_int
+        lib.crane_parse_hostlist.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.crane_compress_hostlist.restype = ctypes.c_int
+        lib.crane_compress_hostlist.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.crane_fits.restype = ctypes.c_int
+        lib.crane_fits.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.crane_fit_count.restype = ctypes.c_int32
+        lib.crane_fit_count.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.crane_fits_batch.restype = None
+        lib.crane_fits_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def parse_hostlist(expr: str) -> list[str] | None:
+    """Native hostlist expansion; None if the library is unavailable.
+    Raises ValueError on malformed expressions."""
+    lib = load()
+    if lib is None:
+        return None
+    cap = max(1 << 16, len(expr) * 64)
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.crane_parse_hostlist(expr.encode(), buf, cap)
+    if n < 0:
+        raise ValueError(f"malformed hostlist expression: {expr!r}")
+    return buf.value.decode().split(",") if n else []
+
+
+def compress_hostlist(names: list[str]) -> str | None:
+    lib = load()
+    if lib is None:
+        return None
+    csv = ",".join(names)
+    cap = max(1 << 16, len(csv) * 2 + 16)
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.crane_compress_hostlist(csv.encode(), buf, cap)
+    if n < 0:
+        raise ValueError("hostlist compression failed")
+    return buf.value.decode()

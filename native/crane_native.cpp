@@ -1,0 +1,268 @@
+// crane_native: C++ implementations of the framework's hot host-side
+// utilities, loaded from Python via ctypes.
+//
+// Mirrors the reference's native utility layer (reference:
+// src/Utilities/PublicHeader/ — the hostlist grammar
+// ParseHostList/HostNameListToStr in String.h:88-105, and the resource
+// algebra operator<= / operator/ in PublicHeader.h:760-778).  The wire
+// API is extern "C" with caller-provided buffers so any language binds.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o libcrane_native.so
+//        crane_native.cpp      (or use the CMakeLists next to this file)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct HostPattern {
+  std::string prefix;
+  std::string suffix;
+  int width = 0;       // zero-pad width (0 = no padding significance)
+  long number = -1;    // -1 = plain name, no numeric part
+};
+
+// Split a hostlist expression at top-level commas (commas inside
+// brackets belong to range lists).
+std::vector<std::string> SplitTopLevel(const std::string& expr) {
+  std::vector<std::string> out;
+  std::string cur;
+  int depth = 0;
+  for (char c : expr) {
+    if (c == '[') depth++;
+    if (c == ']') depth--;
+    if (c == ',' && depth == 0) {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(c);
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
+// Expand one item: "cn[15-18,20]s" -> cn15s cn16s cn17s cn18s cn20s.
+// Returns false on malformed input.
+bool ExpandItem(const std::string& item, std::vector<std::string>* out) {
+  auto lb = item.find('[');
+  if (lb == std::string::npos) {
+    if (item.find(']') != std::string::npos) return false;
+    out->push_back(item);
+    return true;
+  }
+  auto rb = item.find(']', lb);
+  if (rb == std::string::npos) return false;
+  std::string prefix = item.substr(0, lb);
+  std::string ranges = item.substr(lb + 1, rb - lb - 1);
+  std::string suffix = item.substr(rb + 1);
+  if (ranges.empty()) return false;
+
+  std::string part;
+  std::vector<std::pair<std::string, std::string>> bounds;
+  size_t start = 0;
+  while (start <= ranges.size()) {
+    auto comma = ranges.find(',', start);
+    std::string r = ranges.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    if (r.empty()) return false;
+    auto dash = r.find('-');
+    if (dash == std::string::npos) {
+      bounds.emplace_back(r, r);
+    } else {
+      bounds.emplace_back(r.substr(0, dash), r.substr(dash + 1));
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+
+  for (auto& [lo_s, hi_s] : bounds) {
+    if (lo_s.empty() || hi_s.empty()) return false;
+    for (char c : lo_s) if (!isdigit(c)) return false;
+    for (char c : hi_s) if (!isdigit(c)) return false;
+    long lo = std::stol(lo_s), hi = std::stol(hi_s);
+    if (hi < lo || hi - lo > 1000000) return false;
+    int width = (lo_s.size() > 1 && lo_s[0] == '0')
+                    ? static_cast<int>(lo_s.size()) : 0;
+    char buf[64];
+    for (long v = lo; v <= hi; ++v) {
+      if (width > 0)
+        snprintf(buf, sizeof buf, "%0*ld", width, v);
+      else
+        snprintf(buf, sizeof buf, "%ld", v);
+      out->push_back(prefix + buf + suffix);
+    }
+  }
+  return true;
+}
+
+// Parse "name123" into (prefix, number, width); number==-1 if the name
+// has no trailing digits.
+HostPattern SplitTrailingNumber(const std::string& name) {
+  HostPattern p;
+  size_t end = name.size();
+  while (end > 0 && isdigit(name[end - 1])) end--;
+  p.prefix = name.substr(0, end);
+  std::string digits = name.substr(end);
+  if (digits.empty()) {
+    p.number = -1;
+  } else {
+    p.number = std::stol(digits);
+    p.width = (digits.size() > 1 && digits[0] == '0')
+                  ? static_cast<int>(digits.size()) : 0;
+    // a non-padded number still remembers its width for round-trips of
+    // names like "cn001" vs "cn1"
+    if (p.width == 0 && digits.size() > 1 && digits[0] != '0')
+      p.width = 0;
+    if (p.width == 0 && digits[0] == '0' && digits.size() == 1)
+      p.width = 0;
+    if (digits[0] == '0' && digits.size() > 1)
+      p.width = static_cast<int>(digits.size());
+  }
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Expand "cn[01-03],gpu7,n[1,5-6]x" into a comma-separated list written
+// to out (NUL terminated).  Returns the byte length written (excluding
+// NUL), or -1 on malformed input / buffer too small.
+int crane_parse_hostlist(const char* expr, char* out, int out_cap) {
+  if (!expr || !out) return -1;
+  std::vector<std::string> names;
+  for (auto& item : SplitTopLevel(expr)) {
+    if (!ExpandItem(item, &names)) return -1;
+  }
+  std::string joined;
+  for (size_t i = 0; i < names.size(); ++i) {
+    if (i) joined += ',';
+    joined += names[i];
+  }
+  if (static_cast<int>(joined.size()) + 1 > out_cap) return -1;
+  memcpy(out, joined.c_str(), joined.size() + 1);
+  return static_cast<int>(joined.size());
+}
+
+// Compress a comma-separated host list into the bracket grammar
+// ("cn1,cn2,cn3,cn5" -> "cn[1-3,5]").  Preserves zero padding per group.
+// Returns length or -1.
+int crane_compress_hostlist(const char* csv, char* out, int out_cap) {
+  if (!csv || !out) return -1;
+  std::vector<std::string> names = SplitTopLevel(csv);
+
+  // group by (prefix, width); keep first-seen order of groups
+  struct Group {
+    std::string prefix;
+    int width;
+    std::vector<long> nums;
+    std::vector<std::string> plain;  // names without numeric tails
+  };
+  std::vector<Group> groups;
+  auto find_group = [&](const std::string& prefix, int width) -> Group& {
+    for (auto& g : groups)
+      if (g.prefix == prefix && g.width == width) return g;
+    groups.push_back(Group{prefix, width, {}, {}});
+    return groups.back();
+  };
+
+  for (auto& name : names) {
+    HostPattern p = SplitTrailingNumber(name);
+    if (p.number < 0) {
+      Group& g = find_group(name, -1);
+      g.plain.push_back(name);
+    } else {
+      Group& g = find_group(p.prefix, p.width);
+      g.nums.push_back(p.number);
+    }
+  }
+
+  std::string result;
+  char buf[64];
+  for (auto& g : groups) {
+    if (!result.empty()) result += ',';
+    if (g.width == -1) {  // plain name group
+      result += g.prefix;
+      continue;
+    }
+    std::sort(g.nums.begin(), g.nums.end());
+    g.nums.erase(std::unique(g.nums.begin(), g.nums.end()),
+                 g.nums.end());
+    if (g.nums.size() == 1) {
+      if (g.width > 0)
+        snprintf(buf, sizeof buf, "%0*ld", g.width, g.nums[0]);
+      else
+        snprintf(buf, sizeof buf, "%ld", g.nums[0]);
+      result += g.prefix + buf;
+      continue;
+    }
+    result += g.prefix + "[";
+    size_t i = 0;
+    bool first = true;
+    auto emit = [&](long v) {
+      if (g.width > 0)
+        snprintf(buf, sizeof buf, "%0*ld", g.width, v);
+      else
+        snprintf(buf, sizeof buf, "%ld", v);
+      result += buf;
+    };
+    while (i < g.nums.size()) {
+      size_t j = i;
+      while (j + 1 < g.nums.size() && g.nums[j + 1] == g.nums[j] + 1) j++;
+      if (!first) result += ',';
+      first = false;
+      emit(g.nums[i]);
+      if (j > i) {
+        result += '-';
+        emit(g.nums[j]);
+      }
+      i = j + 1;
+    }
+    result += ']';
+  }
+  if (static_cast<int>(result.size()) + 1 > out_cap) return -1;
+  memcpy(out, result.c_str(), result.size() + 1);
+  return static_cast<int>(result.size());
+}
+
+// Resource algebra (reference PublicHeader.h:760-778): req <= avail
+// elementwise over dims dimensions.  Returns 1/0.
+int crane_fits(const int32_t* req, const int32_t* avail, int dims) {
+  for (int d = 0; d < dims; ++d)
+    if (req[d] > avail[d]) return 0;
+  return 1;
+}
+
+// Max-fit count: min over requested dims of avail/req (reference
+// operator/, "minimum quotient across all resource dimensions").
+int32_t crane_fit_count(const int32_t* avail, const int32_t* req,
+                        int dims) {
+  int32_t best = INT32_MAX;
+  for (int d = 0; d < dims; ++d) {
+    if (req[d] <= 0) continue;
+    int32_t q = avail[d] >= 0 ? avail[d] / req[d] : 0;
+    best = std::min(best, q);
+  }
+  return best == INT32_MAX ? (1 << 30) : best;
+}
+
+// Batched feasibility: out[n] = all(req <= avail[n]) for nnodes rows.
+void crane_fits_batch(const int32_t* req, const int32_t* avail,
+                      int nnodes, int dims, uint8_t* out) {
+  for (int n = 0; n < nnodes; ++n) {
+    const int32_t* row = avail + static_cast<int64_t>(n) * dims;
+    uint8_t ok = 1;
+    for (int d = 0; d < dims; ++d) {
+      if (req[d] > row[d]) { ok = 0; break; }
+    }
+    out[n] = ok;
+  }
+}
+
+}  // extern "C"
