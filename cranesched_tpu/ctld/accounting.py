@@ -58,6 +58,8 @@ class Qos:
     max_tres: np.ndarray | None = None             # total in-flight
     max_tres_per_user: np.ndarray | None = None
     max_tres_per_account: np.ndarray | None = None
+    # QoS names this QoS may preempt (reference Qos.preempt set)
+    preempt: set[str] = dataclasses.field(default_factory=set)
     reference_count: int = 0
 
 

@@ -97,6 +97,8 @@ class PendingReason(str, enum.Enum):
     DEPENDENCY = "Dependency"
     DEPENDENCY_NEVER_SATISFIED = "DependencyNeverSatisfied"
     QOS_LIMIT = "QOSResourceLimit"
+    LICENSE = "Licenses"
+    PREEMPTED = "Preempted"
     INVALID = "InvalidSpec"
 
 
@@ -152,6 +154,8 @@ class JobSpec:
     array: ArraySpec | None = None
     # named reservation to run inside (reference ResvMeta)
     reservation: str = ""
+    # consumable licenses: name -> count (reference LicenseManager)
+    licenses: Mapping[str, int] | None = None
     # batch script (run as bash -c by the supervisor) and output path
     # pattern (%j substitutes the job id; reference batch meta)
     script: str = ""

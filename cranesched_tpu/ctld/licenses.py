@@ -1,0 +1,78 @@
+"""Cluster-wide consumable licenses.
+
+Mirrors the reference's LicenseManager (reference:
+src/CraneCtld/Accounting/LicenseManager.h:46-125 — local license counts
+with a reserve→malloc→free lifecycle checked inside the scheduling cycle;
+CheckLicenseCountSufficient is called from NodeSelect,
+JobScheduler.cpp:6739).  Remote license-server sync is out of scope
+(gated, not stubbed): this is the local ledger the cycle consults."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+
+@dataclasses.dataclass
+class License:
+    name: str
+    total: int
+    in_use: int = 0
+
+    @property
+    def free(self) -> int:
+        return self.total - self.in_use
+
+
+class LicenseManager:
+    def __init__(self):
+        self.licenses: dict[str, License] = {}
+
+    def configure(self, name: str, total: int) -> None:
+        lic = self.licenses.get(name)
+        if lic is None:
+            self.licenses[name] = License(name=name, total=total)
+        else:
+            lic.total = total
+
+    def legal(self, wanted: Mapping[str, int] | None) -> str:
+        """Submit-time legality (reference CheckLicensesLegal): every
+        requested license exists and the count fits the TOTAL."""
+        for name, count in (wanted or {}).items():
+            lic = self.licenses.get(name)
+            if lic is None:
+                return f"unknown license {name}"
+            if count > lic.total:
+                return (f"license {name}: requested {count} "
+                        f"> total {lic.total}")
+        return ""
+
+    def sufficient(self, wanted: Mapping[str, int] | None) -> bool:
+        """Cycle-time availability (CheckLicenseCountSufficient)."""
+        return all(count <= self.licenses[name].free
+                   for name, count in (wanted or {}).items()
+                   if name in self.licenses)
+
+    def malloc(self, wanted: Mapping[str, int] | None) -> bool:
+        """Atomically take all or none."""
+        if not self.sufficient(wanted):
+            return False
+        for name, count in (wanted or {}).items():
+            self.licenses[name].in_use += count
+        return True
+
+    def restore(self, wanted: Mapping[str, int] | None) -> None:
+        """Crash recovery: force-account seats a recovered running job
+        already holds.  May push in_use past total (e.g. totals lowered
+        between restarts) — sufficient() then admits nothing new until
+        the overcommit drains, which is the safe direction."""
+        for name, count in (wanted or {}).items():
+            lic = self.licenses.get(name)
+            if lic is not None:
+                lic.in_use += count
+
+    def free(self, wanted: Mapping[str, int] | None) -> None:
+        for name, count in (wanted or {}).items():
+            lic = self.licenses.get(name)
+            if lic is not None:
+                lic.in_use = max(lic.in_use - count, 0)

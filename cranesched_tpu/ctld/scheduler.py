@@ -37,6 +37,7 @@ from cranesched_tpu.ctld.defs import (
     PendingReason,
 )
 from cranesched_tpu.ctld.accounting import AccountMetaContainer
+from cranesched_tpu.ctld.licenses import LicenseManager
 from cranesched_tpu.ctld.meta import MetaContainer
 from cranesched_tpu.models.priority import (
     PendingPriorityAttrs,
@@ -93,6 +94,16 @@ class SchedulerConfig:
     # real node plane: a craned that misses pings for this long is down
     # (reference kCranedTimeoutSec = 30, PublicHeader.h:146)
     craned_timeout: float = 30.0
+    # QoS preemption (reference TryPreempt_, JobScheduler.cpp:6378-6505;
+    # config PreemptType/PreemptMode etc/config.yaml:280-290):
+    # "off" | "requeue" | "cancel" — what happens to the victims
+    preempt_mode: str = "off"
+
+    def __post_init__(self):
+        if self.preempt_mode not in ("off", "requeue", "cancel"):
+            raise ValueError(
+                f"preempt_mode must be off|requeue|cancel, "
+                f"got {self.preempt_mode!r}")
 
 
 @dataclasses.dataclass
@@ -128,6 +139,7 @@ class JobScheduler:
         self.accounts = accounts
         self.account_meta = (AccountMetaContainer(meta.layout)
                              if accounts is not None else None)
+        self.licenses = LicenseManager()
         self.pending: dict[int, Job] = {}    # job_id -> Job, insertion = id order
         self.running: dict[int, Job] = {}
         self.history: dict[int, Job] = {}    # terminal jobs
@@ -178,6 +190,8 @@ class JobScheduler:
             resv = self.meta.reservations.get(spec.reservation)
             if resv is None or not resv.account_allowed(spec.account):
                 return 0
+        if spec.licenses and self.licenses.legal(spec.licenses):
+            return 0  # unknown license or count beyond the total
         if spec.array is not None and not spec.array.task_ids():
             return 0
 
@@ -356,6 +370,11 @@ class JobScheduler:
             job = self.running.get(job_id)
             if job is None:
                 return
+            if node_id not in job.node_ids:
+                # stale report from a previous incarnation's node
+                # (e.g. a preemption kill confirmed after the victim was
+                # requeued and re-placed elsewhere)
+                return
             is_failure = status not in (JobStatus.COMPLETED,
                                         JobStatus.CANCELLED)
             had_failure = any(
@@ -460,6 +479,7 @@ class JobScheduler:
     def _release_job_resources(self, job: Job) -> None:
         self.meta.free_resource(job.job_id, job.node_ids,
                                 self._job_alloc(job))
+        self.licenses.free(job.spec.licenses or {})
         self._free_run_limits(job)
 
     def _malloc_run_limits(self, job: Job) -> bool:
@@ -613,8 +633,10 @@ class JobScheduler:
             pbatch = self._packed_batch(jobs_batch, ordered)
             placements, _ = solve_packed(state, pbatch,
                                          max_nodes=max_nodes)
-            return self._commit(ordered, placements, now,
-                                tasks=np.asarray(placements.tasks))
+            started = self._commit(ordered, placements, now,
+                                   tasks=np.asarray(placements.tasks))
+            started += self._try_preemption(ordered, now)
+            return started
 
         if self.config.backfill:
             state = self._timed_state(now, avail, total, alive, cost0)
@@ -628,7 +650,9 @@ class JobScheduler:
                                          max_nodes=max_nodes)
             start_buckets = None
 
-        return self._commit(ordered, placements, now, start_buckets)
+        started = self._commit(ordered, placements, now, start_buckets)
+        started += self._try_preemption(ordered, now)
+        return started
 
     def _initial_cost(self, now: float, total: np.ndarray) -> np.ndarray:
         """Per-cycle node cost seeded from running jobs' remaining
@@ -773,6 +797,132 @@ class JobScheduler:
             self._finalize(parent)
             self._trigger_dep_event(parent)
 
+    # ------------------------------------------------------------------
+    # QoS preemption (reference TryPreempt_, JobScheduler.cpp:6378-6505:
+    # a blocked job whose QoS lists lower QoS as preemptable evicts their
+    # running jobs; victims ordered lowest-qos-first then youngest-first)
+    # ------------------------------------------------------------------
+
+    def _try_preemption(self, ordered: list[Job], now: float) -> list[int]:
+        if self.config.preempt_mode == "off" or self.accounts is None:
+            return []
+        started = []
+        for job in ordered:
+            if job.job_id not in self.pending:
+                continue  # it placed normally
+            if job.pending_reason not in (PendingReason.RESOURCE,
+                                          PendingReason.PRIORITY):
+                continue
+            if job.spec.task_res is not None or job.spec.exclusive:
+                continue  # packed/exclusive preemption not supported
+            qos = self.accounts.qos.get(job.qos_name)
+            if qos is None or not qos.preempt:
+                continue
+            if self._preempt_for(job, qos.preempt, now):
+                started.append(job.job_id)
+        return started
+
+    def _preempt_for(self, job: Job, preempt_qos: set[str],
+                     now: float) -> bool:
+        req = job.spec.res.encode(self.meta.layout)
+        mask = self._mask_for(job, now)
+        # nodes where evicting preemptable jobs would free enough
+        chosen: list[int] = []
+        victims: set[int] = set()
+        for node in self.meta.nodes.values():
+            if len(chosen) == job.spec.node_num:
+                break
+            if not node.schedulable or not mask[node.node_id]:
+                continue
+            node_victims = [
+                self.running[j] for j in node.running_jobs
+                if j in self.running
+                and self.running[j].qos_name in preempt_qos]
+            potential = node.avail.astype(np.int64).copy()
+            for v in node_victims:
+                idx = v.node_ids.index(node.node_id)
+                potential += self._job_alloc(v)[idx]
+            if not (req <= potential).all():
+                continue
+            # evict as few as possible: lowest qos priority first, then
+            # youngest first (latest start) — reference victim order
+            node_victims.sort(key=lambda v: (v.qos_priority,
+                                             -(v.start_time or 0.0)))
+            avail = node.avail.astype(np.int64).copy()
+            node_evict = []
+            for v in node_victims:
+                if (req <= avail).all():
+                    break
+                idx = v.node_ids.index(node.node_id)
+                avail += self._job_alloc(v)[idx]
+                node_evict.append(v.job_id)
+            if (req <= avail).all():
+                chosen.append(node.node_id)
+                victims.update(node_evict)
+        if len(chosen) < job.spec.node_num:
+            return False
+
+        # node-independent admission checks come BEFORE any eviction so
+        # victims are never killed for a preemptor that cannot start
+        if job.spec.licenses and not self.licenses.malloc(
+                job.spec.licenses):
+            job.pending_reason = PendingReason.LICENSE
+            return False
+        if not self._malloc_run_limits(job):
+            self.licenses.free(job.spec.licenses or {})
+            job.pending_reason = PendingReason.QOS_LIMIT
+            return False
+
+        for victim_id in victims:
+            self._evict(victim_id, now)
+        job.node_ids = chosen
+        job.task_layout = []
+        job.alloc_cache = None
+        if not self.meta.malloc_resource(job.job_id, chosen,
+                                         self._job_alloc(job)):
+            # only a mid-cycle reduce event can get here; undo admission
+            self.licenses.free(job.spec.licenses or {})
+            self._free_run_limits(job)
+            job.node_ids = []
+            return False
+        del self.pending[job.job_id]
+        job.status = JobStatus.RUNNING
+        job.start_time = now
+        job.pending_reason = PendingReason.NONE
+        self.running[job.job_id] = job
+        if self.wal is not None:
+            self.wal.job_started(job)
+        self._trigger_dep_event(job)
+        self.dispatch(job, chosen)
+        return True
+
+    def _evict(self, victim_id: int, now: float) -> None:
+        """Evict a running job for a preemptor: kill its steps, free its
+        resources, then requeue or cancel per PreemptMode."""
+        victim = self.running.get(victim_id)
+        if victim is None:
+            return
+        self.dispatch_terminate(victim_id, now)
+        self._release_job_resources(victim)
+        del self.running[victim_id]
+        if self.config.preempt_mode == "requeue":
+            victim.reset_for_requeue()
+            victim.pending_reason = PendingReason.PREEMPTED
+            if victim.requeue_count > self.config.max_requeue_count:
+                # same cap as every other requeue path: held, operator
+                # must release
+                victim.held = True
+                victim.pending_reason = PendingReason.HELD
+            self.pending[victim_id] = victim
+            if self.wal is not None:
+                self.wal.job_requeued(victim)
+        else:  # cancel
+            victim.status = JobStatus.CANCELLED
+            victim.end_time = now
+            victim.exit_code = 143
+            self._finalize(victim)
+            self._trigger_dep_event(victim)
+
     def _check_craned_timeouts(self, now: float) -> None:
         """Ping-miss failure detection (reference ping FSM + CranedDown,
         SURVEY §3.5): real craneds that stopped pinging are declared dead
@@ -799,6 +949,13 @@ class JobScheduler:
             dep_reason = self._deps_runnable(job, now)
             if dep_reason is not None:
                 job.pending_reason = dep_reason
+                continue
+            if job.spec.licenses and not self.licenses.sufficient(
+                    job.spec.licenses):
+                # reference pre-checks licenses before NodeSelect
+                # (CheckLicenseCountSufficient, cpp:6739) so a blocked
+                # job never idles nodes the solver reserved for it
+                job.pending_reason = PendingReason.LICENSE
                 continue
             out.append(job)
         return out
@@ -999,7 +1156,12 @@ class JobScheduler:
             if dirty_nodes.intersection(node_ids):
                 job.pending_reason = PendingReason.RESOURCE
                 continue
+            if job.spec.licenses and not self.licenses.malloc(
+                    job.spec.licenses):
+                job.pending_reason = PendingReason.LICENSE
+                continue
             if not self._malloc_run_limits(job):
+                self.licenses.free(job.spec.licenses or {})
                 job.pending_reason = PendingReason.QOS_LIMIT
                 continue
             job.node_ids = node_ids
@@ -1008,6 +1170,7 @@ class JobScheduler:
                                if tasks is not None else [])
             if not self.meta.malloc_resource(job.job_id, node_ids,
                                              self._job_alloc(job)):
+                self.licenses.free(job.spec.licenses or {})
                 self._free_run_limits(job)
                 job.node_ids = []
                 job.task_layout = []
@@ -1055,6 +1218,7 @@ class JobScheduler:
             elif job.status == JobStatus.RUNNING:
                 if self.meta.malloc_resource(job_id, job.node_ids,
                                              self._job_alloc(job)):
+                    self.licenses.restore(job.spec.licenses or {})
                     if (self.account_meta is not None and job.qos_name):
                         self.account_meta.restore_run(
                             job.spec.user, job.spec.account, job.qos_name,
@@ -1079,6 +1243,7 @@ class JobScheduler:
                 # suspended jobs hold their allocation across the crash
                 if self.meta.malloc_resource(job_id, job.node_ids,
                                              self._job_alloc(job)):
+                    self.licenses.restore(job.spec.licenses or {})
                     if (self.account_meta is not None and job.qos_name):
                         self.account_meta.restore_run(
                             job.spec.user, job.spec.account, job.qos_name,

@@ -68,6 +68,7 @@ class CraneConfig:
         default_factory=list)
     scheduler: dict[str, Any] = dataclasses.field(default_factory=dict)
     priority: dict[str, Any] = dataclasses.field(default_factory=dict)
+    licenses: list[dict] = dataclasses.field(default_factory=list)
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -114,8 +115,12 @@ class CraneConfig:
             backfill=bool(sc.get("Backfill", True)),
             time_resolution=float(sc.get("TimeResolutionSec", 60)),
             time_buckets=int(sc.get("TimeBuckets", 64)),
-            craned_timeout=float(sc.get("CranedTimeoutSec", 30)))
+            craned_timeout=float(sc.get("CranedTimeoutSec", 30)),
+            preempt_mode=str(sc.get("PreemptMode", "off")).lower())
         scheduler = JobScheduler(meta, config)
+        for lic in self.licenses:
+            scheduler.licenses.configure(str(lic["name"]),
+                                         int(lic["total"]))
         return meta, scheduler
 
 
@@ -148,4 +153,5 @@ def load_config(path: str) -> CraneConfig:
         nodes=nodes,
         partitions=partitions,
         scheduler=raw.get("Scheduler", {}) or {},
-        priority=raw.get("Priority", {}) or {})
+        priority=raw.get("Priority", {}) or {},
+        licenses=raw.get("Licenses", []) or [])

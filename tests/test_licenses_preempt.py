@@ -1,0 +1,186 @@
+"""Licenses (reference LicenseManager.h:46-125) and QoS preemption
+(reference TryPreempt_, JobScheduler.cpp:6378-6505)."""
+
+import numpy as np
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.accounting import (
+    Account,
+    AccountManager,
+    AdminLevel,
+    Qos,
+    User,
+)
+
+
+def make_cluster(num_nodes=4, cpu=8, config=None, accounts=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=cpu, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, config or SchedulerConfig(backfill=False),
+                         accounts=accounts)
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+    return meta, sched, cluster
+
+
+def spec(cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+# ---------------- licenses ----------------
+
+def test_license_legality_at_submit():
+    meta, sched, cluster = make_cluster()
+    sched.licenses.configure("matlab", 4)
+    assert sched.submit(spec(licenses={"nope": 1}), now=0.0) == 0
+    assert sched.submit(spec(licenses={"matlab": 5}), now=0.0) == 0
+    assert sched.submit(spec(licenses={"matlab": 4}), now=0.0) > 0
+
+
+def test_license_gating_in_cycle():
+    meta, sched, cluster = make_cluster(num_nodes=8)
+    sched.licenses.configure("matlab", 3)
+    a = sched.submit(spec(licenses={"matlab": 2}, runtime=10.0), now=0.0)
+    b = sched.submit(spec(licenses={"matlab": 2}, runtime=10.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [a]    # only 3 seats: b waits
+    assert sched.job_info(b).pending_reason == PendingReason.LICENSE
+    assert sched.licenses.licenses["matlab"].in_use == 2
+    cluster.advance_to(11.0)
+    started = sched.schedule_cycle(now=11.0)
+    assert started == [b]    # a's seats freed on completion
+    cluster.run_until_drained(start=12.0)
+    assert sched.licenses.licenses["matlab"].in_use == 0
+
+
+def test_license_freed_on_cancel():
+    meta, sched, cluster = make_cluster()
+    sched.licenses.configure("lic", 1)
+    a = sched.submit(spec(licenses={"lic": 1}, runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sched.cancel(a, now=1.0)
+    sched.schedule_cycle(now=2.0)
+    assert sched.licenses.licenses["lic"].in_use == 0
+
+
+# ---------------- preemption ----------------
+
+def preempt_setup(mode):
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="low", priority=0))
+    mgr.add_qos("root", Qos(name="high", priority=1000,
+                            preempt={"low"}))
+    mgr.add_account("root", Account(name="hpc",
+                                    allowed_qos={"low", "high"},
+                                    default_qos="low"))
+    mgr.add_user("root", User(name="alice", uid=1), "hpc")
+    meta, sched, cluster = make_cluster(
+        num_nodes=2, cpu=4,
+        config=SchedulerConfig(backfill=False, preempt_mode=mode),
+        accounts=mgr)
+    return meta, sched, cluster
+
+
+def hpc_spec(cpu, qos, runtime=500.0, **kw):
+    return spec(cpu=cpu, runtime=runtime, user="alice", account="hpc",
+                qos=qos, **kw)
+
+
+def test_preempt_requeue_mode():
+    meta, sched, cluster = preempt_setup("requeue")
+    lo1 = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    lo2 = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert len(sched.running) == 2   # cluster full of low-qos work
+
+    hi = sched.submit(hpc_spec(4.0, "high", runtime=10.0), now=1.0)
+    started = sched.schedule_cycle(now=1.0)
+    assert hi in started
+    assert sched.job_info(hi).status == JobStatus.RUNNING
+    # exactly one victim was evicted and requeued as Preempted
+    victims = [j for j in (lo1, lo2)
+               if sched.job_info(j).status == JobStatus.PENDING]
+    assert len(victims) == 1
+    assert sched.job_info(victims[0]).pending_reason == \
+        PendingReason.PREEMPTED
+    assert sched.job_info(victims[0]).requeue_count == 1
+    # everything eventually completes (victim reruns after hi finishes)
+    cluster.run_until_drained(start=2.0, max_cycles=5000)
+    assert all(j.status == JobStatus.COMPLETED
+               for j in sched.history.values())
+
+
+def test_preempt_cancel_mode():
+    meta, sched, cluster = preempt_setup("cancel")
+    lo = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    lo2 = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    hi = sched.submit(hpc_spec(4.0, "high", runtime=10.0), now=1.0)
+    started = sched.schedule_cycle(now=1.0)
+    assert hi in started
+    cancelled = [j for j in (lo, lo2)
+                 if sched.job_info(j).status == JobStatus.CANCELLED]
+    assert len(cancelled) == 1
+
+
+def test_no_preemption_without_rights_or_mode():
+    # same shape but preempt_mode off: the high job just waits
+    meta, sched, cluster = preempt_setup("off")
+    lo1 = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    lo2 = sched.submit(hpc_spec(4.0, "low"), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    hi = sched.submit(hpc_spec(4.0, "high", runtime=10.0), now=1.0)
+    assert sched.schedule_cycle(now=1.0) == []
+    assert sched.job_info(hi).status == JobStatus.PENDING
+    # and low-qos jobs cannot preempt each other
+    meta2, sched2, cluster2 = preempt_setup("requeue")
+    a = sched2.submit(hpc_spec(4.0, "low"), now=0.0)
+    b = sched2.submit(hpc_spec(4.0, "low"), now=0.0)
+    sched2.schedule_cycle(now=0.0)
+    c = sched2.submit(hpc_spec(4.0, "low"), now=1.0)
+    assert sched2.schedule_cycle(now=1.0) == []
+
+
+def test_preempt_evicts_fewest_lowest_youngest():
+    # one node, two 2-cpu low jobs (started at different times); a 2-cpu
+    # high job needs only ONE eviction: the youngest low job goes
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="low", priority=0))
+    mgr.add_qos("root", Qos(name="high", priority=1000,
+                            preempt={"low"}))
+    mgr.add_account("root", Account(name="hpc",
+                                    allowed_qos={"low", "high"},
+                                    default_qos="low"))
+    mgr.add_user("root", User(name="alice", uid=1), "hpc")
+    meta, sched, cluster = make_cluster(
+        num_nodes=1, cpu=4,
+        config=SchedulerConfig(backfill=False, preempt_mode="requeue"),
+        accounts=mgr)
+    older = sched.submit(hpc_spec(2.0, "low"), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    younger = sched.submit(hpc_spec(2.0, "low"), now=5.0)
+    sched.schedule_cycle(now=5.0)
+    hi = sched.submit(hpc_spec(2.0, "high", runtime=10.0), now=10.0)
+    started = sched.schedule_cycle(now=10.0)
+    assert hi in started
+    assert sched.job_info(older).status == JobStatus.RUNNING
+    assert sched.job_info(younger).status == JobStatus.PENDING
