@@ -5,17 +5,23 @@ A from-scratch rebuild of the capability surface of PKUHPC/CraneSched
 
 - ``ops/``      JAX primitives for the scheduler's resource algebra
                 (fixed-point cpu, feasibility masks, fit counts).
-- ``models/``   Scheduler "models": jit-compiled solve() functions mapping
-                (cluster state, job batch) -> placements. The flagship model
-                is the per-cycle constraint solve that replaces the C++
-                NodeSelect loop (reference: src/CraneCtld/JobScheduler.cpp:6507).
-- ``parallel/`` Mesh/sharding layer: shard_map'd solvers that split the node
-                axis across devices with ICI collectives for the argmin merge.
-- ``ctld/``     Host control plane: job lifecycle, queues, accounting,
-                persistence (WAL), dispatch (reference: src/CraneCtld/).
-- ``craned/``   Node plane: simulated in-process craneds for tests plus the
-                interface the real C++ daemon implements.
-- ``utils/``    Hostlist grammar, config parsing, logging.
+- ``models/``   jit-compiled solvers mapping (cluster state, job batch) ->
+                placements: the greedy scan, the time-axis backfill grid,
+                task packing/exclusive, the fast exact speculative paths,
+                and the multifactor priority sort (reference:
+                src/CraneCtld/JobScheduler.cpp:6507,7606).
+- ``parallel/`` Mesh/sharding layer: shard_map'd solvers splitting the node
+                axis across devices with ICI collectives for the merges.
+- ``ctld/``     Host control plane: job lifecycle, queues, accounting/QoS,
+                licenses, reservations, dependencies, arrays, preemption,
+                WAL persistence + recovery (reference: src/CraneCtld/).
+- ``craned/``   Node plane: the real daemon (registration FSM, supervisor
+                processes, cgroups, health checks) and the simulated
+                cluster used by tests and replays.
+- ``rpc/``      gRPC control fabric + CLI client (protos/crane.proto).
+- ``utils/``    Hostlist grammar, YAML config, native C++ bridge.
+
+See ARCHITECTURE.md for the full component map against the reference.
 """
 
 __version__ = "0.1.0"

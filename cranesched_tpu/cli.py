@@ -161,6 +161,21 @@ def cmd_ccancel(args) -> int:
     return rc
 
 
+def cmd_cnode(args) -> int:
+    client = _client(args)
+    reply = client.modify_node(args.node, args.action)
+    if not reply.ok:
+        print(f"cnode: {reply.error}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_cstats(args) -> int:
+    client = _client(args)
+    print(client.query_stats().json)
+    return 0
+
+
 def cmd_ccontrol(args) -> int:
     client = _client(args)
     if args.action in ("hold", "release"):
@@ -275,6 +290,15 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("cacct", help="show accounting history")
     p.add_argument("--user", "-u", default="")
     p.set_defaults(func=cmd_cacct)
+
+    p = sub.add_parser("cnode", help="node control (drain/resume/...)")
+    p.add_argument("action",
+                   choices=["drain", "resume", "poweroff", "wake"])
+    p.add_argument("node")
+    p.set_defaults(func=cmd_cnode)
+
+    p = sub.add_parser("cstats", help="scheduler cycle statistics")
+    p.set_defaults(func=cmd_cstats)
 
     p = sub.add_parser("cresv", help="manage reservations")
     p.add_argument("action", choices=["create", "delete"])

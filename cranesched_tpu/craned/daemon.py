@@ -44,9 +44,11 @@ class CranedState(enum.Enum):
 
 
 class _Step:
-    def __init__(self, job_id: int, proc: subprocess.Popen):
+    def __init__(self, job_id: int, proc: subprocess.Popen,
+                 incarnation: int = 0):
         self.job_id = job_id
         self.proc = proc
+        self.incarnation = incarnation
         self.cancelled = False
 
 
@@ -55,7 +57,9 @@ class CranedDaemon:
                  cpu: float = 8.0, mem_bytes: int = 16 << 30,
                  partitions=("default",), workdir: str = "/tmp",
                  ping_interval: float = 5.0,
-                 cgroup_root: str = "/sys/fs/cgroup"):
+                 cgroup_root: str = "/sys/fs/cgroup",
+                 health_program: str = "",
+                 health_interval: float = 30.0):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -63,6 +67,11 @@ class CranedDaemon:
         self.partitions = tuple(partitions)
         self.workdir = workdir
         self.ping_interval = ping_interval
+        # periodic node health program (reference HealthCheck config,
+        # Craned.cpp:731-751): nonzero exit drains the node at ctld
+        self.health_program = health_program
+        self.health_interval = health_interval
+        self.healthy = True
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = CgroupV2(cgroup_root)
@@ -174,7 +183,7 @@ class CranedDaemon:
             raise RuntimeError(f"supervisor handshake failed: {ready!r}")
         proc.stdin.write(b"GO\n")
         proc.stdin.flush()
-        step = _Step(job_id, proc)
+        step = _Step(job_id, proc, incarnation=request.incarnation)
         with self._lock:
             self._steps[job_id] = step
             self._spawning.discard(job_id)
@@ -212,7 +221,8 @@ class CranedDaemon:
                                           time.time(),
                                           node_id=self.node_id
                                           if self.node_id is not None
-                                          else -1)
+                                          else -1,
+                                          incarnation=step.incarnation)
         except (grpc.RpcError, ValueError):
             pass  # ctld down / client closed: the ping timeout + WAL
                   # reconcile at re-registration
@@ -243,7 +253,32 @@ class CranedDaemon:
         self._server.start()
         self.address = f"127.0.0.1:{port}"
         threading.Thread(target=self._fsm_loop, daemon=True).start()
+        if self.health_program:
+            threading.Thread(target=self._health_loop,
+                             daemon=True).start()
         return port
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            if self.state != CranedState.READY or self.node_id is None:
+                continue
+            try:
+                result = subprocess.run(
+                    ["bash", "-c", self.health_program],
+                    capture_output=True, text=True, timeout=60)
+                healthy = result.returncode == 0
+                message = (result.stdout or result.stderr).strip()[:200]
+            except (OSError, subprocess.SubprocessError) as exc:
+                healthy, message = False, str(exc)[:200]
+            if healthy != self.healthy:
+                try:
+                    self._ctld.craned_health(self.node_id, healthy,
+                                             message)
+                    # only acknowledge the transition once the ctld has
+                    # it — a lost report retries next interval
+                    self.healthy = healthy
+                except (grpc.RpcError, ValueError):
+                    pass
 
     def _register(self) -> bool:
         try:

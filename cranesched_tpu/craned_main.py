@@ -24,6 +24,8 @@ def main(argv=None) -> int:
     ap.add_argument("--listen", default="127.0.0.1:0")
     ap.add_argument("--ping-interval", type=float, default=5.0)
     ap.add_argument("--cgroup-root", default="/sys/fs/cgroup")
+    ap.add_argument("--health-program", default="")
+    ap.add_argument("--health-interval", type=float, default=30.0)
     args = ap.parse_args(argv)
 
     from cranesched_tpu.craned.daemon import CranedDaemon
@@ -34,7 +36,9 @@ def main(argv=None) -> int:
         mem_bytes=parse_mem(args.memory),
         partitions=tuple(args.partitions.split(",")),
         workdir=args.workdir, ping_interval=args.ping_interval,
-        cgroup_root=args.cgroup_root)
+        cgroup_root=args.cgroup_root,
+        health_program=args.health_program,
+        health_interval=args.health_interval)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

@@ -60,10 +60,20 @@ class NodeMeta:
     address: str = ""
     last_ping: float = 0.0
     expect_pings: bool = False
+    # power state (reference PublicDefs.proto:87-96: ACTIVE/IDLE/
+    # SLEEPING/POWEREDOFF; transitions driven by control ops + plugins)
+    power_state: str = "ACTIVE"
+    # operator drain and health drain are SEPARATE flags (the reference
+    # tracks distinct control/drain reasons): a recovering health check
+    # must not clear a maintenance drain
+    health_drained: bool = False
+    health_message: str = ""          # last health-check report
 
     @property
     def schedulable(self) -> bool:
-        return self.alive and not self.drained
+        return (self.alive and not self.drained
+                and not self.health_drained
+                and self.power_state != "POWEREDOFF")
 
 
 @dataclasses.dataclass

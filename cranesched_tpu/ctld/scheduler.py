@@ -150,6 +150,13 @@ class JobScheduler:
         self._mask_cache: dict[tuple, np.ndarray] = {}
         self._mask_cache_epoch = -1
         self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
+        # observability (reference per-phase wall-clock trace,
+        # JobScheduler.cpp:1444-1447,1723-1903)
+        self.stats = {
+            "cycles": 0, "jobs_started_total": 0,
+            "jobs_submitted_total": 0, "jobs_finished_total": 0,
+            "last_cycle": {},
+        }
 
     # ------------------------------------------------------------------
     # submit / cancel / hold (reference SubmitJobToScheduler :3405,
@@ -210,6 +217,7 @@ class JobScheduler:
 
         job_id = self._next_job_id
         self._next_job_id += 1
+        self.stats["jobs_submitted_total"] += 1
         job = Job(job_id=job_id, spec=spec, submit_time=now,
                   qos_name=qos_name, qos_priority=qos_priority,
                   held=spec.held)
@@ -361,7 +369,8 @@ class JobScheduler:
 
     def step_status_change(self, job_id: int, status: JobStatus,
                            exit_code: int, now: float,
-                           node_id: int = -1) -> None:
+                           node_id: int = -1,
+                           incarnation: int | None = None) -> None:
         """node_id >= 0 is a per-node report from a real craned; the job
         is terminal only when every allocated node reported (or on the
         first failure, which kills the rest).  node_id == -1 is a
@@ -374,6 +383,11 @@ class JobScheduler:
                 # stale report from a previous incarnation's node
                 # (e.g. a preemption kill confirmed after the victim was
                 # requeued and re-placed elsewhere)
+                return
+            if (incarnation is not None
+                    and incarnation != job.requeue_count):
+                # stale report from a pre-requeue step, even if the new
+                # incarnation landed on the same node
                 return
             is_failure = status not in (JobStatus.COMPLETED,
                                         JobStatus.CANCELLED)
@@ -506,6 +520,7 @@ class JobScheduler:
             job.run_usage_taken = False
 
     def _finalize(self, job: Job) -> None:
+        self.stats["jobs_finished_total"] += 1
         # array children never took a submit slot (the template owns it)
         if (self.account_meta is not None and job.qos_name
                 and job.array_parent_id is None):
@@ -597,14 +612,24 @@ class JobScheduler:
 
     def schedule_cycle(self, now: float) -> list[int]:
         """One cycle: drain status changes, snapshot, device solve, commit,
-        dispatch.  Returns the job_ids started this cycle."""
+        dispatch.  Returns the job_ids started this cycle.  Per-phase
+        wall-clock timings land in ``stats['last_cycle']`` (reference
+        phase trace, JobScheduler.cpp:1444-1447)."""
+        import time as _time
+        t0 = _time.perf_counter()
         self.process_status_changes()
         self._check_craned_timeouts(now)
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
+        t_prelude = _time.perf_counter()
 
+        self.stats["cycles"] += 1
         candidates = self._pending_candidates(now)
         if not candidates:
+            self.stats["last_cycle"] = {
+                "prelude_ms": round((t_prelude - t0) * 1e3, 3),
+                "pending": 0, "started": 0,
+                "running": len(self.running)}
             return []
         limit = self.config.schedule_batch_size
         if len(candidates) > limit:
@@ -636,6 +661,8 @@ class JobScheduler:
             started = self._commit(ordered, placements, now,
                                    tasks=np.asarray(placements.tasks))
             started += self._try_preemption(ordered, now)
+            self._record_cycle_stats(t0, t_prelude, candidates, started,
+                                     _time.perf_counter(), "packed")
             return started
 
         if self.config.backfill:
@@ -652,7 +679,23 @@ class JobScheduler:
 
         started = self._commit(ordered, placements, now, start_buckets)
         started += self._try_preemption(ordered, now)
+        self._record_cycle_stats(
+            t0, t_prelude, candidates, started, _time.perf_counter(),
+            "backfill" if self.config.backfill else "immediate")
         return started
+
+    def _record_cycle_stats(self, t0, t_prelude, candidates, started,
+                            t_end, solver: str) -> None:
+        self.stats["jobs_started_total"] += len(started)
+        self.stats["last_cycle"] = {
+            "solver": solver,
+            "prelude_ms": round((t_prelude - t0) * 1e3, 3),
+            "solve_commit_ms": round((t_end - t_prelude) * 1e3, 3),
+            "total_ms": round((t_end - t0) * 1e3, 3),
+            "pending": len(candidates),
+            "started": len(started),
+            "running": len(self.running),
+        }
 
     def _initial_cost(self, now: float, total: np.ndarray) -> np.ndarray:
         """Per-cycle node cost seeded from running jobs' remaining

@@ -80,6 +80,22 @@ class CtldClient:
         return self._call("DeleteReservation", pb.NameRequest(name=name),
                           pb.OkReply)
 
+    def modify_node(self, name: str, action: str) -> pb.OkReply:
+        return self._call("ModifyNode",
+                          pb.ModifyNodeRequest(name=name, action=action),
+                          pb.OkReply)
+
+    def query_stats(self) -> pb.StatsReply:
+        return self._call("QueryStats", pb.StatsRequest(), pb.StatsReply)
+
+    def craned_health(self, node_id: int, healthy: bool,
+                      message: str = "") -> pb.OkReply:
+        return self._call(
+            "CranedHealth",
+            pb.CranedHealthRequest(node_id=node_id, healthy=healthy,
+                                   message=message),
+            pb.OkReply)
+
     # ---- internal ----
 
     def craned_register(self, name, total: pb.ResourceSpec,
@@ -97,12 +113,14 @@ class CtldClient:
                           pb.OkReply)
 
     def step_status_change(self, job_id, status, exit_code, time,
-                           node_id: int = -1) -> pb.OkReply:
+                           node_id: int = -1,
+                           incarnation: int = 0) -> pb.OkReply:
         return self._call(
             "StepStatusChange",
             pb.StepStatusChangeRequest(job_id=job_id, status=status,
                                        exit_code=exit_code, time=time,
-                                       node_id=node_id),
+                                       node_id=node_id,
+                                       incarnation=incarnation),
             pb.OkReply)
 
     def tick(self, now: float) -> pb.TickReply:

@@ -77,7 +77,8 @@ class GrpcDispatcher:
             try:
                 reply = stub.call("ExecuteStep", pb.ExecuteStepRequest(
                     job_id=job.job_id, spec=spec_pb,
-                    tasks_on_node=ntasks, now=time.time()))
+                    tasks_on_node=ntasks, now=time.time(),
+                    incarnation=job.requeue_count))
                 return "" if reply.ok else reply.error
             except grpc.RpcError as exc:
                 return f"push to node {node_id} failed: {exc.code()}"

@@ -33,9 +33,11 @@ from cranesched_tpu.rpc.convert import job_to_pb, res_from_pb, spec_from_pb
 
 
 def _node_state(node) -> str:
+    if node.power_state == "POWEREDOFF":
+        return "POWEREDOFF"
     if not node.alive:
         return "DOWN"
-    if node.drained:
+    if node.drained or node.health_drained:
         return "DRAIN"
     if (node.avail == node.total).all():
         return "IDLE"
@@ -165,6 +167,55 @@ class CtldServer:
             ok = self.scheduler.meta.delete_reservation(request.name)
         return pb.OkReply(ok=ok, error="" if ok else "no such reservation")
 
+    def ModifyNode(self, request, context):
+        """Node control ops (reference control states
+        PublicDefs.proto:98-106 + PowerStateChange,
+        CtldGrpcServer.cpp:2583-2649)."""
+        with self._lock:
+            meta = self.scheduler.meta
+            if request.name not in meta._name_to_id:
+                return pb.OkReply(ok=False, error="unknown node")
+            node = meta.node_by_name(request.name)
+            action = request.action.lower()
+            if action == "drain":
+                meta.drain(node.node_id, True)
+            elif action == "resume":
+                meta.drain(node.node_id, False)
+            elif action == "poweroff":
+                node.power_state = "POWEREDOFF"
+                self.scheduler.on_craned_down(node.node_id, self._now())
+            elif action == "wake":
+                node.power_state = "ACTIVE"
+                if not node.expect_pings:
+                    node.alive = True  # sim nodes wake immediately;
+                                       # real ones wake at re-register
+            else:
+                return pb.OkReply(ok=False,
+                                  error=f"unknown action {action!r}")
+            return pb.OkReply(ok=True)
+
+    def QueryStats(self, request, context):
+        import json as _json
+        with self._lock:
+            return pb.StatsReply(
+                json=_json.dumps(self.scheduler.stats))
+
+    def CranedHealth(self, request, context):
+        """Health-check report (reference HealthCheck config,
+        Craned.cpp:731-751): unhealthy nodes drain until they report
+        healthy again."""
+        with self._lock:
+            node = self.scheduler.meta.nodes.get(request.node_id)
+            if node is None:
+                return pb.OkReply(ok=False, error="unknown node")
+            node.health_message = request.message
+            node.health_drained = not request.healthy
+            if not request.healthy:
+                from cranesched_tpu.ctld.meta import ResReduceEvent
+                self.scheduler.meta._log_event(
+                    ResReduceEvent(node.node_id))
+            return pb.OkReply(ok=True)
+
     # ---- internal (node plane + virtual time) ----
 
     def CranedRegister(self, request, context):
@@ -172,6 +223,9 @@ class CtldServer:
             meta = self.scheduler.meta
             if request.name in meta._name_to_id:
                 node = meta.node_by_name(request.name)
+                if node.power_state == "POWEREDOFF":
+                    # refused until the operator wakes it (cnode wake)
+                    return pb.CranedRegisterReply(ok=False)
             else:
                 node = meta.add_node(
                     request.name,
@@ -222,7 +276,8 @@ class CtldServer:
             self.scheduler.step_status_change(
                 request.job_id, JobStatus(request.status),
                 request.exit_code, request.time,
-                node_id=request.node_id)
+                node_id=request.node_id,
+                incarnation=request.incarnation)
         return pb.OkReply(ok=True)
 
     def Tick(self, request, context):
@@ -246,6 +301,9 @@ class CtldServer:
         "QueryClusterInfo": (pb.QueryClusterRequest, pb.QueryClusterReply),
         "CreateReservation": (pb.CreateReservationRequest, pb.OkReply),
         "DeleteReservation": (pb.NameRequest, pb.OkReply),
+        "ModifyNode": (pb.ModifyNodeRequest, pb.OkReply),
+        "QueryStats": (pb.StatsRequest, pb.StatsReply),
+        "CranedHealth": (pb.CranedHealthRequest, pb.OkReply),
         "CranedRegister": (pb.CranedRegisterRequest,
                            pb.CranedRegisterReply),
         "CranedPing": (pb.CranedPingRequest, pb.OkReply),

@@ -1,0 +1,143 @@
+"""Node health checks (reference HealthCheck, Craned.cpp:731-751), power
+and control states (PublicDefs.proto:87-106), and cycle statistics
+(reference per-phase trace, JobScheduler.cpp:1444)."""
+
+import json
+import time
+
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.rpc import CtldClient, serve
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+
+@pytest.fixture()
+def ctld_sim():
+    meta = MetaContainer()
+    for i in range(3):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    sim = SimCluster(sched)
+    sched.dispatch = sim.dispatch
+    sched.dispatch_terminate = sim.terminate
+    server, port = serve(sched, sim=sim, tick_mode=True)
+    client = CtldClient(f"127.0.0.1:{port}")
+    yield client, sched, meta
+    client.close()
+    server.stop()
+
+
+def wait_for(pred, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_drain_resume_via_rpc(ctld_sim):
+    client, sched, meta = ctld_sim
+    assert client.modify_node("cn00", "drain").ok
+    assert meta.node_by_name("cn00").drained
+    nodes = {n.name: n.state for n in client.query_cluster().nodes}
+    assert nodes["cn00"] == "DRAIN"
+    # drained node is unschedulable
+    jid = client.submit(
+        __import__("cranesched_tpu.rpc", fromlist=["crane_pb2"])
+        .crane_pb2.JobSpec(res=__import__(
+            "cranesched_tpu.rpc", fromlist=["crane_pb2"])
+            .crane_pb2.ResourceSpec(cpu=8.0), sim_runtime=5.0)).job_id
+    client.tick(0.0)
+    info = client.query_jobs(job_ids=[jid]).jobs[0]
+    assert info.status == "Running"
+    assert info.node_names[0] != "cn00"
+    assert client.modify_node("cn00", "resume").ok
+    assert not meta.node_by_name("cn00").drained
+
+
+def test_poweroff_and_wake(ctld_sim):
+    client, sched, meta = ctld_sim
+    assert client.modify_node("cn01", "poweroff").ok
+    node = meta.node_by_name("cn01")
+    assert not node.alive and node.power_state == "POWEREDOFF"
+    states = {n.name: n.state for n in client.query_cluster().nodes}
+    assert states["cn01"] == "POWEREDOFF"
+    assert client.modify_node("cn01", "wake").ok
+    assert meta.node_by_name("cn01").alive
+    assert client.modify_node("ghost", "drain").ok is False
+    assert client.modify_node("cn01", "explode").ok is False
+
+
+def test_health_report_drains_and_restores(ctld_sim):
+    client, sched, meta = ctld_sim
+    node = meta.node_by_name("cn02")
+    assert client.craned_health(node.node_id, False,
+                                "disk full").ok
+    assert node.health_drained and node.health_message == "disk full"
+    assert not node.schedulable
+    assert client.craned_health(node.node_id, True, "ok").ok
+    assert not node.health_drained and node.schedulable
+    # a recovering health check must NOT clear an operator drain
+    assert client.modify_node("cn02", "drain").ok
+    assert client.craned_health(node.node_id, True, "ok").ok
+    assert node.drained and not node.schedulable
+
+
+def test_cycle_stats_exposed(ctld_sim):
+    client, sched, meta = ctld_sim
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    client.submit(pb.JobSpec(res=pb.ResourceSpec(cpu=1.0),
+                             sim_runtime=5.0))
+    client.tick(0.0)
+    stats = json.loads(client.query_stats().json)
+    assert stats["cycles"] >= 1
+    assert stats["jobs_submitted_total"] == 1
+    assert stats["jobs_started_total"] == 1
+    assert stats["last_cycle"]["started"] == 1
+    assert stats["last_cycle"]["total_ms"] > 0
+
+
+def test_real_craned_health_program(tmp_path):
+    """A failing health program on a REAL craned drains the node; a
+    passing one restores it."""
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False,
+                                               craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    sched.dispatch = dispatcher.dispatch
+    server, port = serve(sched, cycle_interval=0.2,
+                         dispatcher=dispatcher)
+    flag = tmp_path / "healthy"
+    flag.write_text("yes")
+    d = CranedDaemon(
+        "hn00", f"127.0.0.1:{port}", cpu=4.0, mem_bytes=4 << 30,
+        workdir=str(tmp_path), ping_interval=0.3,
+        cgroup_root=str(tmp_path / "nocg"),
+        health_program=f"test -f {flag}", health_interval=0.3)
+    d.start()
+    try:
+        assert wait_for(lambda: d.state == CranedState.READY)
+        node = sched.meta.node_by_name("hn00")
+        flag.unlink()   # health program starts failing
+        assert wait_for(lambda: node.health_drained)
+        flag.write_text("yes")
+        assert wait_for(lambda: not node.health_drained)
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()
