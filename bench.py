@@ -22,10 +22,36 @@ import numpy as np
 BASELINE_DECISIONS_PER_SEC = 100_000.0
 
 
+def _devices_with_timeout(timeout_s: float):
+    """TPU acquisition through this environment's tunnel can hang for
+    many minutes; probe it in a subprocess and fall back to CPU so the
+    bench always produces a number."""
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if probe.returncode == 0 and "ok" in probe.stdout:
+            return  # real backend reachable; this process uses it too
+    except subprocess.TimeoutExpired:
+        pass
+    # unreachable: force CPU before jax initializes in THIS process
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> int:
     num_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
     num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
+
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        # probe whenever CPU isn't already forced: auto-detection with an
+        # unset JAX_PLATFORMS can hang on the TPU tunnel just as well
+        _devices_with_timeout(
+            float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
 
     import jax
     import jax.numpy as jnp
@@ -86,6 +112,11 @@ def main() -> int:
                               f"use one of {['auto', *solvers]}"}))
             return 1
         solvers = {which: solvers[which]}
+    elif dev.platform == "cpu" and num_jobs * num_nodes > 10_000_000:
+        # the blocked solver's parallel validation is built for TPU
+        # throughput; on the CPU fallback at large shapes it would blow
+        # the bench budget, so auto mode times only the greedy scan there
+        solvers.pop("blocked", None)
 
     results = {}
     placed_by = {}

@@ -86,21 +86,42 @@ def _fmt_table(rows, headers) -> str:
     return "\n".join(out)
 
 
+def _parse_gres(text: str) -> dict:
+    """'gpu:a100:2,fpga::1' -> {"gpu:a100": 2, "fpga:": 1}."""
+    out = {}
+    for part in text.split(","):
+        bits = part.split(":")
+        if len(bits) == 3:
+            name, typ, count = bits
+        elif len(bits) == 2:
+            name, count = bits
+            typ = ""
+        else:
+            raise SystemExit(f"crane: bad --gres {part!r} "
+                             "(use name[:type]:count)")
+        try:
+            n = int(count)
+        except ValueError:
+            raise SystemExit(f"crane: bad --gres count {count!r}")
+        if n < 1:
+            raise SystemExit(f"crane: --gres count must be >= 1, "
+                             f"got {n}")
+        out[f"{name}:{typ}"] = n
+    return out
+
+
 def cmd_cbatch(args) -> int:
     from cranesched_tpu.rpc import crane_pb2 as pb
-    spec = pb.JobSpec(
-        name=args.job_name, user=args.user,
-        account=args.account, partition=args.partition,
-        res=pb.ResourceSpec(cpu=args.cpu, mem_bytes=_parse_mem(args.mem),
-                            memsw_bytes=_parse_mem(args.memsw or args.mem)),
-        node_num=args.nodes, time_limit=args.time, qos=args.qos,
-        held=args.hold, exclusive=args.exclusive,
-        reservation=args.reservation,
-        include_nodes=args.nodelist.split(",") if args.nodelist else [],
-        exclude_nodes=args.exclude.split(",") if args.exclude else [],
-        requeue_if_failed=args.requeue,
-        deps_is_or=args.dependency_any,
-        sim_runtime=args.sim_runtime or 0.0)
+    spec = _build_spec(args)
+    spec.held = args.hold
+    spec.exclusive = args.exclusive
+    spec.include_nodes.extend(
+        args.nodelist.split(",") if args.nodelist else [])
+    spec.exclude_nodes.extend(
+        args.exclude.split(",") if args.exclude else [])
+    spec.requeue_if_failed = args.requeue
+    spec.deps_is_or = args.dependency_any
+    spec.sim_runtime = args.sim_runtime or 0.0
     if args.ntasks:
         spec.ntasks = args.ntasks
         spec.ntasks_per_node_min = args.ntasks_per_node_min
@@ -120,6 +141,80 @@ def cmd_cbatch(args) -> int:
         return 0
     print(f"submit failed: {reply.error}", file=sys.stderr)
     return 1
+
+
+def _build_spec(args):
+    """Shared JobSpec construction for cbatch and crun."""
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    spec = pb.JobSpec(
+        name=args.job_name, user=args.user,
+        account=args.account, partition=args.partition,
+        res=pb.ResourceSpec(cpu=args.cpu, mem_bytes=_parse_mem(args.mem),
+                            memsw_bytes=_parse_mem(args.memsw or args.mem)),
+        node_num=args.nodes, time_limit=args.time, qos=args.qos,
+        reservation=args.reservation,
+        script=getattr(args, "script", "") or "",
+        output_path=getattr(args, "output", "") or "")
+    if args.gres:
+        for key, count in _parse_gres(args.gres).items():
+            spec.res.gres[key] = count
+    return spec
+
+
+def cmd_crun(args) -> int:
+    """Interactive-style run: submit, wait, stream the output file.
+
+    Streams via the shared filesystem (the reference likewise assumes
+    shared storage for job output; its cfored bidi-stream I/O hub is the
+    network-transparent variant of this seam)."""
+    import tempfile
+    import time as _time
+    cleanup_path = None
+    if not args.output:
+        fd, args.output = tempfile.mkstemp(prefix="crun_",
+                                           suffix=".out")
+        os.close(fd)
+        cleanup_path = args.output
+    spec = _build_spec(args)
+    client = _client(args)
+    reply = client.submit(spec)
+    if not reply.job_id:
+        print(f"crun: submit failed: {reply.error}", file=sys.stderr)
+        return 1
+    job_id = reply.job_id
+    out_path = args.output.replace("%j", str(job_id))
+    offset = 0
+    exit_code = 0
+    try:
+        while True:
+            jobs = client.query_jobs(job_ids=[job_id],
+                                     include_history=True).jobs
+            status = jobs[0].status if jobs else "?"
+            try:
+                with open(out_path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read()
+                if chunk:
+                    sys.stdout.write(chunk.decode(errors="replace"))
+                    sys.stdout.flush()
+                    offset += len(chunk)
+            except OSError:
+                pass
+            if status not in ("Pending", "Running", "Suspended"):
+                exit_code = jobs[0].exit_code if jobs else 1
+                break
+            _time.sleep(args.poll)
+    except KeyboardInterrupt:
+        client.cancel(job_id)
+        print(f"\ncrun: job {job_id} cancelled", file=sys.stderr)
+        return 130
+    finally:
+        if cleanup_path is not None:
+            try:
+                os.unlink(cleanup_path)
+            except OSError:
+                pass
+    return exit_code
 
 
 def cmd_cqueue(args) -> int:
@@ -249,6 +344,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mem", default="0")
     p.add_argument("--memsw", default="")
     p.add_argument("--nodes", "-N", type=int, default=1)
+    p.add_argument("--gres", default="",
+                   help="name[:type]:count, comma-separated")
     p.add_argument("--time", "-t", type=int, default=3600)
     p.add_argument("--qos", "-q", default="")
     p.add_argument("--hold", action="store_true")
@@ -266,7 +363,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cpus-per-task", type=float, default=1.0)
     p.add_argument("--mem-per-task", default="0")
     p.add_argument("--sim-runtime", type=float, default=0.0)
+    p.add_argument("--script", default="",
+                   help="batch script (bash -c) for real node planes")
+    p.add_argument("--output", "-o", default="",
+                   help="output file pattern (%%j = job id)")
     p.set_defaults(func=cmd_cbatch)
+
+    p = sub.add_parser("crun", help="run a command and stream output")
+    p.add_argument("script", help="command to run (bash -c)")
+    p.add_argument("--job-name", "-J", default="crun")
+    p.add_argument("--user", default=os.environ.get("USER", "user"))
+    p.add_argument("--account", "-A", default="default")
+    p.add_argument("--partition", "-p", default="default")
+    p.add_argument("--cpu", "-c", type=float, default=1.0)
+    p.add_argument("--mem", default="0")
+    p.add_argument("--memsw", default="")
+    p.add_argument("--nodes", "-N", type=int, default=1)
+    p.add_argument("--gres", default="")
+    p.add_argument("--time", "-t", type=int, default=3600)
+    p.add_argument("--qos", "-q", default="")
+    p.add_argument("--reservation", default="")
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--poll", type=float, default=0.3)
+    p.set_defaults(func=cmd_crun)
 
     p = sub.add_parser("cqueue", help="show the job queue")
     p.add_argument("--user", "-u", default="")

@@ -176,6 +176,13 @@ class JobScheduler:
         if not (1 <= spec.node_num
                 <= min(self.config.max_nodes_per_job, len(part.node_ids))):
             return 0
+        # unknown GRES pairs can never be satisfied (the layout is the
+        # cluster's configured inventory) — clean rejection, not a crash
+        known_gres = set(self.meta.layout.gres_dims)
+        for res in (spec.res, spec.task_res):
+            if res is not None and res.gres:
+                if not set(res.gres) <= known_gres:
+                    return 0
         # CheckJobValidity analog: the per-node minimum request (base +
         # task_res * min tasks, reference min_res_view cpp:6152) must fit
         # at least one node's *total* in the partition.

@@ -48,6 +48,9 @@ class NodeConfig:
     cpu: float
     mem_bytes: int
     partitions: list[str]
+    # GRES inventory: (name, type) -> slots, e.g. {("gpu","a100"): 4}
+    # (reference device config, etc/config.yaml:139-160)
+    gres: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -77,8 +80,14 @@ class CraneConfig:
         from cranesched_tpu.ctld.scheduler import (
             JobScheduler, SchedulerConfig)
         from cranesched_tpu.models.priority import PriorityWeights
+        from cranesched_tpu.ops.resources import ResourceLayout
 
-        meta = MetaContainer()
+        # the GRES inventory across all nodes defines the tensor layout
+        # (a static compile-time axis, reference treats device config as
+        # cluster topology)
+        gres_pairs = sorted({key for n in self.nodes for key in n.gres})
+        layout = ResourceLayout.from_gres_names(gres_pairs)
+        meta = MetaContainer(layout)
         for part in self.partitions:
             meta.add_partition(
                 part.name, priority=part.priority,
@@ -91,6 +100,7 @@ class CraneConfig:
                     meta.layout.encode(cpu=node_cfg.cpu,
                                        mem_bytes=node_cfg.mem_bytes,
                                        memsw_bytes=node_cfg.mem_bytes,
+                                       gres=node_cfg.gres,
                                        is_capacity=True),
                     partitions=tuple(node_cfg.partitions))
 
@@ -130,12 +140,17 @@ def load_config(path: str) -> CraneConfig:
 
     nodes = []
     for entry in raw.get("Nodes", []):
+        gres = {}
+        for key, slots in (entry.get("gres") or {}).items():
+            name, _, typ = str(key).partition(":")
+            gres[(name, typ)] = int(slots)
         nodes.append(NodeConfig(
             names=parse_hostlist(str(entry["name"])),
             cpu=float(entry.get("cpu", 1)),
             mem_bytes=parse_mem(entry.get("memory", 0)),
             partitions=[str(p) for p in entry.get("partitions",
-                                                  ["default"])]))
+                                                  ["default"])],
+            gres=gres))
     partitions = []
     for entry in raw.get("Partitions", []):
         partitions.append(PartitionConfig(

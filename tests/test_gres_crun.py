@@ -1,0 +1,116 @@
+"""GRES end to end (config inventory → layout → scheduling → CLI flag)
+and crun (submit + stream output through the real node plane)."""
+
+import os
+import subprocess
+import sys
+import time
+
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+from cranesched_tpu.utils.config import load_config
+
+
+def test_gres_from_config_schedules_correctly(tmp_path):
+    cfg_path = tmp_path / "c.yaml"
+    cfg_path.write_text("""
+Nodes:
+  - name: "cpu[1-2]"
+    cpu: 8
+    memory: 16G
+  - name: "gpu1"
+    cpu: 8
+    memory: 16G
+    gres: {"gpu:a100": 2}
+Partitions: [{name: default}]
+""")
+    cfg = load_config(str(cfg_path))
+    meta, sched = cfg.build()
+    assert meta.layout.gres_pairs == (("gpu", "a100"),)
+    for node in meta.nodes.values():
+        node.alive = True
+    sim = SimCluster(sched)
+    sched.dispatch = sim.dispatch
+    sched.dispatch_terminate = sim.terminate
+
+    # a GPU job must land on gpu1; a second exceeding slots must wait
+    g1 = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0, gres={("gpu", "a100"): 2}),
+        sim_runtime=50.0), now=0.0)
+    g2 = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0, gres={("gpu", "a100"): 1}),
+        sim_runtime=50.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [g1]
+    assert sched.job_info(g1).node_ids == [
+        meta.node_by_name("gpu1").node_id]
+    assert sched.job_info(g2).status == JobStatus.PENDING
+    # non-GPU jobs still use the cpu nodes freely
+    c1 = sched.submit(JobSpec(res=ResourceSpec(cpu=8.0),
+                              sim_runtime=10.0), now=1.0)
+    assert sched.schedule_cycle(now=1.0) == [c1]
+    # gpu slots free on completion
+    sim.advance_to(51.0)
+    assert sched.schedule_cycle(now=51.0) == [g2]
+
+
+def test_gres_request_exceeding_any_node_rejected(tmp_path):
+    cfg_path = tmp_path / "c.yaml"
+    cfg_path.write_text("""
+Nodes:
+  - name: "gpu1"
+    cpu: 8
+    memory: 16G
+    gres: {"gpu:a100": 2}
+Partitions: [{name: default}]
+""")
+    meta, sched = load_config(str(cfg_path)).build()
+    for node in meta.nodes.values():
+        node.alive = True
+    assert sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0, gres={("gpu", "a100"): 3})),
+        now=0.0) == 0
+
+
+def test_crun_streams_real_output(tmp_path):
+    from cranesched_tpu.ctld import MetaContainer
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    dispatcher = GrpcDispatcher(sched)
+    sched.dispatch = dispatcher.dispatch
+    sched.dispatch_terminate = dispatcher.terminate
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("crn0", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.3,
+                     cgroup_root=str(tmp_path / "nocg"))
+    d.start()
+    try:
+        deadline = time.time() + 10
+        while d.state != CranedState.READY and time.time() < deadline:
+            time.sleep(0.05)
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        out = tmp_path / "crun_%j.out"
+        r = subprocess.run(
+            [sys.executable, "-m", "cranesched_tpu.cli",
+             "--server", f"127.0.0.1:{port}", "crun",
+             "echo streamed-$CRANE_JOB_ID; exit 4",
+             "--cpu", "1", "--output", str(out)],
+            capture_output=True, text=True, env=env, cwd="/root/repo",
+            timeout=60)
+        assert "streamed-1" in r.stdout
+        assert r.returncode == 4          # child's exit code propagates
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()
