@@ -27,7 +27,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
              "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120)
         return True
@@ -70,6 +70,13 @@ def load():
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8)]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.crane_solve_greedy.restype = ctypes.c_int
+        lib.crane_solve_greedy.argtypes = [
+            i32p, i32p, u8p, i32p, ctypes.c_int, ctypes.c_int,
+            i32p, i32p, i32p, u8p, i32p, i32p, u8p,
+            ctypes.c_int, ctypes.c_int, u8p, i32p, i32p]
         _lib = lib
         return _lib
 
@@ -90,6 +97,70 @@ def parse_hostlist(expr: str) -> list[str] | None:
     if n < 0:
         raise ValueError(f"malformed hostlist expression: {expr!r}")
     return buf.value.decode().split(",") if n else []
+
+
+def solve_greedy_native(avail, total, alive, cost, req, node_num,
+                        time_limit, valid, max_nodes: int, mask=None,
+                        job_part=None, node_part=None):
+    """Native greedy placement — bit-identical to models.solver
+    solve_greedy (asserted in tests/test_native_solver.py).
+
+    Eligibility comes from either a dense ``mask`` [J, N] or partition id
+    vectors (``job_part``/``node_part``) for shapes where the dense mask
+    is too big.  Returns (placed, nodes, reason, avail', cost') or None
+    when the native library is unavailable."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    if np.asarray(avail).shape[1] > 16:
+        return None  # beyond Treap::kMaxDims: caller falls back to JAX
+    if mask is None:
+        parts = np.asarray(node_part)
+        jparts = np.asarray(job_part)
+        if (parts.size and (parts.min() < 0 or parts.max() >= 4096)) or \
+                (jparts.size and (jparts.min() < 0
+                                  or jparts.max() >= 4096)):
+            return None  # degenerate partition ids: fall back to JAX
+    avail = np.ascontiguousarray(avail, np.int32).copy()
+    total = np.ascontiguousarray(total, np.int32)
+    alive = np.ascontiguousarray(alive, np.uint8)
+    cost = np.ascontiguousarray(cost, np.int32).copy()
+    req = np.ascontiguousarray(req, np.int32)
+    node_num = np.ascontiguousarray(node_num, np.int32)
+    time_limit = np.ascontiguousarray(time_limit, np.int32)
+    valid = np.ascontiguousarray(valid, np.uint8)
+    n, dims = avail.shape
+    j = req.shape[0]
+    max_nodes = min(max_nodes, n)
+    placed = np.zeros(j, np.uint8)
+    nodes = np.full((j, max_nodes), -1, np.int32)
+    reason = np.zeros(j, np.int32)
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    def p32(a):
+        return a.ctypes.data_as(i32p)
+
+    def pu8(a):
+        return a.ctypes.data_as(u8p)
+
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, np.uint8)
+        mask_p, jp_p, np_p = pu8(mask), None, None
+    else:
+        jp = np.ascontiguousarray(job_part, np.int32)
+        npart = np.ascontiguousarray(node_part, np.int32)
+        mask_p, jp_p, np_p = None, p32(jp), p32(npart)
+    rc = lib.crane_solve_greedy(
+        p32(avail), p32(total), pu8(alive), p32(cost), n, dims,
+        p32(req), p32(node_num), p32(time_limit),
+        mask_p, jp_p, np_p, pu8(valid), j, max_nodes,
+        pu8(placed), p32(nodes), p32(reason))
+    if rc < 0:
+        raise ValueError("crane_solve_greedy: bad arguments")
+    return placed.astype(bool), nodes, reason, avail, cost
 
 
 def compress_hostlist(names: list[str]) -> str | None:
