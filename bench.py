@@ -126,7 +126,7 @@ def main() -> int:
         "blocked": lambda: solve_blocked(state, jobs, max_nodes=2,
                                          block_size=128),
     }
-    if dev.platform == "cpu":
+    if dev.platform == "cpu" and native.available():
         # the host C++ solver only competes for the headline number when
         # the measurement is a CPU measurement anyway — on a real TPU the
         # reported decisions/sec must be a device number

@@ -98,12 +98,20 @@ class SchedulerConfig:
     # config PreemptType/PreemptMode etc/config.yaml:280-290):
     # "off" | "requeue" | "cancel" — what happens to the victims
     preempt_mode: str = "off"
+    # solver backend for immediate-fit cycles: "auto" prefers the native
+    # C++ treap solver (bit-identical, ~fastest single-host) and falls
+    # back to the device scan; "device" forces JAX; "native" requires the
+    # C++ library.  Backfill and packed cycles always run on device.
+    solver: str = "auto"
 
     def __post_init__(self):
         if self.preempt_mode not in ("off", "requeue", "cancel"):
             raise ValueError(
                 f"preempt_mode must be off|requeue|cancel, "
                 f"got {self.preempt_mode!r}")
+        if self.solver not in ("auto", "device", "native"):
+            raise ValueError(
+                f"solver must be auto|device|native, got {self.solver!r}")
 
 
 @dataclasses.dataclass
@@ -679,16 +687,27 @@ class JobScheduler:
                                            max_nodes=max_nodes)
             start_buckets = np.asarray(placements.start_bucket)
         else:
-            state = make_cluster_state(avail, total, alive, cost0)
-            placements, _ = solve_greedy(state, jobs_batch,
-                                         max_nodes=max_nodes)
+            placements = None
+            solver_name = "immediate"
+            if self.config.solver in ("auto", "native"):
+                placements = self._solve_native(avail, total, alive,
+                                                cost0, jobs_batch,
+                                                max_nodes)
+                if placements is not None:
+                    solver_name = "native"
+                elif self.config.solver == "native":
+                    raise RuntimeError("native solver unavailable")
+            if placements is None:
+                state = make_cluster_state(avail, total, alive, cost0)
+                placements, _ = solve_greedy(state, jobs_batch,
+                                             max_nodes=max_nodes)
             start_buckets = None
 
         started = self._commit(ordered, placements, now, start_buckets)
         started += self._try_preemption(ordered, now)
         self._record_cycle_stats(
             t0, t_prelude, candidates, started, _time.perf_counter(),
-            "backfill" if self.config.backfill else "immediate")
+            "backfill" if self.config.backfill else solver_name)
         return started
 
     def _record_cycle_stats(self, t0, t_prelude, candidates, started,
@@ -703,6 +722,29 @@ class JobScheduler:
             "started": len(started),
             "running": len(self.running),
         }
+
+    def _solve_native(self, avail, total, alive, cost0, jobs_batch,
+                      max_nodes):
+        """The C++ treap solver for immediate-fit cycles (bit-identical
+        to solve_greedy; tests/test_native_solver.py).  Returns None when
+        the library or shape is unsupported — caller falls back."""
+        from cranesched_tpu.utils import native
+
+        class _Shim:
+            pass
+
+        out = native.solve_greedy_native(
+            avail, total, alive.astype(np.uint8), cost0,
+            np.asarray(jobs_batch.req), np.asarray(jobs_batch.node_num),
+            np.asarray(jobs_batch.time_limit),
+            np.asarray(jobs_batch.valid).astype(np.uint8),
+            max_nodes=max_nodes,
+            mask=np.asarray(jobs_batch.part_mask))
+        if out is None:
+            return None
+        shim = _Shim()
+        shim.placed, shim.nodes, shim.reason = out[0], out[1], out[2]
+        return shim
 
     def _initial_cost(self, now: float, total: np.ndarray) -> np.ndarray:
         """Per-cycle node cost seeded from running jobs' remaining

@@ -110,18 +110,25 @@ def solve_greedy_native(avail, total, alive, cost, req, node_num,
     is too big.  Returns (placed, nodes, reason, avail', cost') or None
     when the native library is unavailable."""
     import numpy as np
-    lib = load()
-    if lib is None:
-        return None
+    # pure-shape checks BEFORE load(): never trigger a g++ build for a
+    # call that cannot use the library anyway
     if np.asarray(avail).shape[1] > 16:
         return None  # beyond Treap::kMaxDims: caller falls back to JAX
     if mask is None:
         parts = np.asarray(node_part)
         jparts = np.asarray(job_part)
-        if (parts.size and (parts.min() < 0 or parts.max() >= 4096)) or \
-                (jparts.size and (jparts.min() < 0
-                                  or jparts.max() >= 4096)):
-            return None  # degenerate partition ids: fall back to JAX
+        if (parts.size and parts.min() < 0) or \
+                (jparts.size and jparts.min() < 0):
+            return None  # negative ids: fall back to JAX
+        # partition ids are LABELS: densely remap them so the C++ side's
+        # per-partition storage is O(distinct partitions), not O(max id)
+        uniq, inv = np.unique(np.concatenate([parts, jparts]),
+                              return_inverse=True)
+        node_part = inv[: parts.size].astype(np.int32)
+        job_part = inv[parts.size:].astype(np.int32)
+    lib = load()
+    if lib is None:
+        return None
     avail = np.ascontiguousarray(avail, np.int32).copy()
     total = np.ascontiguousarray(total, np.int32)
     alive = np.ascontiguousarray(alive, np.uint8)

@@ -83,6 +83,27 @@ def test_native_degenerate_inputs_fall_back_to_none():
         node_part=np.zeros(4, np.int32)) is None
 
 
+def test_native_sparse_partition_labels_remapped():
+    # partition ids are labels, not indices: large sparse labels must
+    # work (densely remapped), matching the dense-mask result
+    rng = np.random.default_rng(9)
+    state, jobs = _random_problem(rng, num_jobs=40, num_nodes=16,
+                                  max_nodes=2, dead_frac=0.0)
+    node_part = rng.choice([7, 500, 3999], 16).astype(np.int32)
+    job_part = rng.choice([7, 500, 3999], 40).astype(np.int32)
+    mask = (job_part[:, None] == node_part[None, :])
+    args = (np.asarray(state.avail), np.asarray(state.total),
+            np.asarray(state.alive), np.asarray(state.cost),
+            np.asarray(jobs.req), np.asarray(jobs.node_num),
+            np.asarray(jobs.time_limit), np.asarray(jobs.valid))
+    a = native.solve_greedy_native(*args, max_nodes=2, mask=mask)
+    b = native.solve_greedy_native(*args, max_nodes=2,
+                                   job_part=job_part,
+                                   node_part=node_part)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
 def test_native_partition_ids_equal_dense_mask():
     rng = np.random.default_rng(42)
     state, jobs = _random_problem(rng, num_jobs=60, num_nodes=32,
