@@ -137,7 +137,7 @@ class JobScheduler:
     def __init__(self, meta: MetaContainer,
                  config: SchedulerConfig | None = None,
                  dispatch: Callable[[Job, list[int]], None] | None = None,
-                 wal=None, accounts=None):
+                 wal=None, accounts=None, submit_hook=None):
         self.meta = meta
         self.config = config or SchedulerConfig()
         self.dispatch = dispatch or (lambda job, nodes: None)
@@ -148,6 +148,10 @@ class JobScheduler:
         self.account_meta = (AccountMetaContainer(meta.layout)
                              if accounts is not None else None)
         self.licenses = LicenseManager()
+        # submit hook (the reference's Lua JobSubmitLuaScript seam,
+        # LuaJobHandler.h:39: rewrite the spec or reject with a message):
+        # JobSpec -> JobSpec (possibly modified) | None (reject)
+        self.submit_hook = submit_hook
         self.pending: dict[int, Job] = {}    # job_id -> Job, insertion = id order
         self.running: dict[int, Job] = {}
         self.history: dict[int, Job] = {}    # terminal jobs
@@ -173,6 +177,10 @@ class JobScheduler:
 
     def submit(self, spec: JobSpec, now: float) -> int:
         """Validate and enqueue; returns job_id (0 = rejected)."""
+        if self.submit_hook is not None:
+            spec = self.submit_hook(spec)
+            if spec is None:
+                return 0
         if len(self.pending) >= self.config.pending_queue_max_size:
             return 0
         part = self.meta.partitions.get(spec.partition)

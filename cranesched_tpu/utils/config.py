@@ -72,6 +72,10 @@ class CraneConfig:
     scheduler: dict[str, Any] = dataclasses.field(default_factory=dict)
     priority: dict[str, Any] = dataclasses.field(default_factory=dict)
     licenses: list[dict] = dataclasses.field(default_factory=list)
+    # path to a Python submit hook module defining
+    # job_submit(spec) -> spec | None (reference JobSubmitLuaScript,
+    # etc/config.yaml:119)
+    submit_hook_path: str = ""
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -127,11 +131,29 @@ class CraneConfig:
             time_buckets=int(sc.get("TimeBuckets", 64)),
             craned_timeout=float(sc.get("CranedTimeoutSec", 30)),
             preempt_mode=str(sc.get("PreemptMode", "off")).lower())
-        scheduler = JobScheduler(meta, config)
+        hook = None
+        if self.submit_hook_path:
+            hook = load_submit_hook(self.submit_hook_path)
+        scheduler = JobScheduler(meta, config, submit_hook=hook)
         for lic in self.licenses:
             scheduler.licenses.configure(str(lic["name"]),
                                          int(lic["total"]))
         return meta, scheduler
+
+
+def load_submit_hook(path: str):
+    """Load job_submit(spec) -> spec | None from a Python file (the
+    reference embeds Lua for the same seam; here the operator's hook is
+    plain Python)."""
+    import importlib.util
+    spec_obj = importlib.util.spec_from_file_location("crane_submit_hook",
+                                                      path)
+    module = importlib.util.module_from_spec(spec_obj)
+    spec_obj.loader.exec_module(module)
+    hook = getattr(module, "job_submit", None)
+    if hook is None:
+        raise ValueError(f"{path} does not define job_submit(spec)")
+    return hook
 
 
 def load_config(path: str) -> CraneConfig:
@@ -169,4 +191,5 @@ def load_config(path: str) -> CraneConfig:
         partitions=partitions,
         scheduler=raw.get("Scheduler", {}) or {},
         priority=raw.get("Priority", {}) or {},
-        licenses=raw.get("Licenses", []) or [])
+        licenses=raw.get("Licenses", []) or [],
+        submit_hook_path=str(raw.get("SubmitHook", "") or ""))

@@ -1,0 +1,66 @@
+"""Submit hooks: the reference's Lua JobSubmitLuaScript seam
+(LuaJobHandler.h:39-42) as a Python plugin — rewrite specs or reject."""
+
+import dataclasses
+
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.utils.config import load_config, load_submit_hook
+
+
+def make_sched(hook=None):
+    meta = MetaContainer()
+    meta.add_node("n0", meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                           memsw_bytes=16 << 30,
+                                           is_capacity=True))
+    meta.craned_up(0)
+    return JobScheduler(meta, SchedulerConfig(backfill=False),
+                        submit_hook=hook)
+
+
+def test_hook_rewrites_and_rejects():
+    def hook(spec):
+        if spec.name.startswith("forbidden"):
+            return None
+        if spec.time_limit > 600:
+            return dataclasses.replace(spec, time_limit=600)
+        return spec
+
+    sched = make_sched(hook)
+    assert sched.submit(JobSpec(name="forbidden-x",
+                                res=ResourceSpec(cpu=1.0)), now=0.0) == 0
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                               time_limit=7200), now=0.0)
+    assert jid > 0
+    assert sched.job_info(jid).spec.time_limit == 600  # clamped
+
+
+def test_hook_loaded_from_config(tmp_path):
+    hook_py = tmp_path / "hook.py"
+    hook_py.write_text(
+        "import dataclasses\n"
+        "def job_submit(spec):\n"
+        "    if spec.account == 'banned':\n"
+        "        return None\n"
+        "    return dataclasses.replace(spec, name='hooked-' + spec.name)\n")
+    cfg_path = tmp_path / "c.yaml"
+    cfg_path.write_text(f"""
+Nodes:
+  - name: n0
+    cpu: 8
+    memory: 16G
+Partitions: [{{name: default}}]
+SubmitHook: {hook_py}
+""")
+    meta, sched = load_config(str(cfg_path)).build()
+    meta.craned_up(0)
+    assert sched.submit(JobSpec(account="banned",
+                                res=ResourceSpec(cpu=1.0)), now=0.0) == 0
+    jid = sched.submit(JobSpec(name="j", res=ResourceSpec(cpu=1.0)),
+                       now=0.0)
+    assert sched.job_info(jid).spec.name == "hooked-j"
