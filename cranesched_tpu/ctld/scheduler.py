@@ -178,8 +178,16 @@ class JobScheduler:
     def submit(self, spec: JobSpec, now: float) -> int:
         """Validate and enqueue; returns job_id (0 = rejected)."""
         if self.submit_hook is not None:
-            spec = self.submit_hook(spec)
+            # operator code: a crashing or misbehaving hook rejects the
+            # job, never the control plane (the reference's Lua seam
+            # treats hook failure as reject-with-message)
+            try:
+                spec = self.submit_hook(spec)
+            except Exception:
+                return 0
             if spec is None:
+                return 0
+            if not isinstance(spec, JobSpec):
                 return 0
         if len(self.pending) >= self.config.pending_queue_max_size:
             return 0

@@ -148,6 +148,9 @@ def load_submit_hook(path: str):
     import importlib.util
     spec_obj = importlib.util.spec_from_file_location("crane_submit_hook",
                                                       path)
+    if spec_obj is None or spec_obj.loader is None:
+        raise ValueError(f"cannot load submit hook from {path!r} "
+                         "(must be a Python file)")
     module = importlib.util.module_from_spec(spec_obj)
     spec_obj.loader.exec_module(module)
     hook = getattr(module, "job_submit", None)

@@ -40,6 +40,30 @@ def test_hook_rewrites_and_rejects():
     assert sched.job_info(jid).spec.time_limit == 600  # clamped
 
 
+def test_crashing_or_bad_hook_rejects_not_crashes():
+    def crashing(spec):
+        raise RuntimeError("boom")
+
+    sched = make_sched(crashing)
+    assert sched.submit(JobSpec(res=ResourceSpec(cpu=1.0)), now=0.0) == 0
+
+    def wrong_type(spec):
+        return {"not": "a JobSpec"}
+
+    sched2 = make_sched(wrong_type)
+    assert sched2.submit(JobSpec(res=ResourceSpec(cpu=1.0)), now=0.0) == 0
+
+
+def test_hook_path_errors_are_legible(tmp_path):
+    import pytest
+    with pytest.raises(ValueError):
+        load_submit_hook(str(tmp_path))        # a directory
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    with pytest.raises(ValueError):
+        load_submit_hook(str(empty))           # no job_submit
+
+
 def test_hook_loaded_from_config(tmp_path):
     hook_py = tmp_path / "hook.py"
     hook_py.write_text(
