@@ -304,6 +304,31 @@ def cmd_cacct(args) -> int:
     return 0
 
 
+def cmd_cacctmgr(args) -> int:
+    import json as _json
+    client = _client(args)
+    payload = {}
+    for kv in args.set or []:
+        key, _, value = kv.partition("=")
+        if not _:
+            print(f"cacctmgr: bad --set {kv!r} (use key=value)",
+                  file=sys.stderr)
+            return 2
+        try:
+            payload[key] = _json.loads(value)
+        except _json.JSONDecodeError:
+            payload[key] = value
+    if args.name:
+        payload.setdefault("name", args.name)
+    reply = client.acct_mgr(args.actor, args.action, payload)
+    if not reply.ok:
+        print(f"cacctmgr: {reply.error}", file=sys.stderr)
+        return 1
+    if reply.json:
+        print(_json.dumps(_json.loads(reply.json), indent=2))
+    return 0
+
+
 def cmd_cresv(args) -> int:
     client = _client(args)
     if args.action == "create":
@@ -418,6 +443,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("cstats", help="scheduler cycle statistics")
     p.set_defaults(func=cmd_cstats)
+
+    p = sub.add_parser("cacctmgr", help="accounts/users/QoS admin")
+    p.add_argument("action",
+                   choices=["add_qos", "add_account", "add_user",
+                            "block_user", "block_account",
+                            "set_admin_level", "show"])
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("--actor", default=os.environ.get("USER", "root"))
+    p.add_argument("--set", action="append", metavar="KEY=VALUE",
+                   help="payload fields (JSON values accepted)")
+    p.set_defaults(func=cmd_cacctmgr)
 
     p = sub.add_parser("cresv", help="manage reservations")
     p.add_argument("action", choices=["create", "delete"])

@@ -180,14 +180,27 @@ class JobScheduler:
         if self.submit_hook is not None:
             # operator code: a crashing or misbehaving hook rejects the
             # job, never the control plane (the reference's Lua seam
-            # treats hook failure as reject-with-message)
+            # treats hook failure as reject-with-message) — but the
+            # failure must stay diagnosable: log it and count it
             try:
                 spec = self.submit_hook(spec)
             except Exception:
+                import logging
+                import traceback
+                logging.getLogger("cranesched.ctld").error(
+                    "submit hook raised:\n%s", traceback.format_exc())
+                self.stats["submit_hook_failures"] = (
+                    self.stats.get("submit_hook_failures", 0) + 1)
                 return 0
             if spec is None:
                 return 0
             if not isinstance(spec, JobSpec):
+                import logging
+                logging.getLogger("cranesched.ctld").error(
+                    "submit hook returned %r (expected JobSpec or None)",
+                    type(spec).__name__)
+                self.stats["submit_hook_failures"] = (
+                    self.stats.get("submit_hook_failures", 0) + 1)
                 return 0
         if len(self.pending) >= self.config.pending_queue_max_size:
             return 0

@@ -88,6 +88,15 @@ class CtldClient:
     def query_stats(self) -> pb.StatsReply:
         return self._call("QueryStats", pb.StatsRequest(), pb.StatsReply)
 
+    def acct_mgr(self, actor: str, action: str,
+                 payload: dict | None = None) -> pb.AcctMgrReply:
+        import json as _json
+        return self._call(
+            "AcctMgr",
+            pb.AcctMgrRequest(actor=actor, action=action,
+                              payload=_json.dumps(payload or {})),
+            pb.AcctMgrReply)
+
     def craned_health(self, node_id: int, healthy: bool,
                       message: str = "") -> pb.OkReply:
         return self._call(

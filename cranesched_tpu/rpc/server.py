@@ -200,6 +200,77 @@ class CtldServer:
             return pb.StatsReply(
                 json=_json.dumps(self.scheduler.stats))
 
+    def AcctMgr(self, request, context):
+        """Accounting CRUD (reference cacctmgr -> AccountManager RPC
+        surface, AccountManager.h:33-445): one multiplexed action with a
+        JSON payload; RBAC enforced by the manager via ``actor``."""
+        import json as _json
+        from cranesched_tpu.ctld.accounting import (
+            Account, AccountingError, AdminLevel, Qos, User)
+        mgr = self.scheduler.accounts
+        if mgr is None:
+            return pb.AcctMgrReply(ok=False,
+                                   error="accounting is not enabled")
+        try:
+            args = _json.loads(request.payload) if request.payload \
+                else {}
+        except _json.JSONDecodeError as exc:
+            return pb.AcctMgrReply(ok=False, error=f"bad payload: {exc}")
+        actor = request.actor
+        try:
+            with self._lock:
+                action = request.action
+                if action == "add_qos":
+                    preempt = set(args.pop("preempt", []))
+                    mgr.add_qos(actor, Qos(preempt=preempt, **args))
+                elif action == "add_account":
+                    allowed_qos = set(args.pop("allowed_qos", []))
+                    mgr.add_account(actor, Account(
+                        allowed_qos=allowed_qos, **args))
+                elif action == "add_user":
+                    account = args.pop("account")
+                    mgr.add_user(actor, User(**args), account)
+                elif action == "block_user":
+                    mgr.block_user(actor, args["name"], args["account"],
+                                   args.get("blocked", True))
+                elif action == "block_account":
+                    mgr.block_account(actor, args["name"],
+                                      args.get("blocked", True))
+                elif action == "set_admin_level":
+                    mgr.set_admin_level(actor, args["name"],
+                                        AdminLevel[args["level"].upper()])
+                elif action == "show":
+                    doc = {
+                        "accounts": {
+                            name: {"parent": a.parent,
+                                   "users": sorted(a.users),
+                                   "allowed_qos": sorted(a.allowed_qos),
+                                   "default_qos": a.default_qos,
+                                   "blocked": a.blocked}
+                            for name, a in mgr.accounts.items()},
+                        "users": {
+                            name: {"accounts": sorted(u.accounts),
+                                   "admin_level": u.admin_level.name}
+                            for name, u in mgr.users.items()},
+                        "qos": {
+                            name: {"priority": q.priority,
+                                   "preempt": sorted(q.preempt)}
+                            for name, q in mgr.qos.items()},
+                    }
+                    return pb.AcctMgrReply(ok=True,
+                                           json=_json.dumps(doc))
+                else:
+                    return pb.AcctMgrReply(
+                        ok=False, error=f"unknown action {action!r}")
+            return pb.AcctMgrReply(ok=True)
+        except AccountingError as exc:
+            return pb.AcctMgrReply(ok=False, error=str(exc))
+        except Exception as exc:  # malformed payloads of any shape come
+            # back as a legible reply, never a raw gRPC error
+            return pb.AcctMgrReply(
+                ok=False, error=f"bad payload for {request.action}: "
+                                f"{type(exc).__name__}: {exc}")
+
     def CranedHealth(self, request, context):
         """Health-check report (reference HealthCheck config,
         Craned.cpp:731-751): unhealthy nodes drain until they report
@@ -303,6 +374,7 @@ class CtldServer:
         "DeleteReservation": (pb.NameRequest, pb.OkReply),
         "ModifyNode": (pb.ModifyNodeRequest, pb.OkReply),
         "QueryStats": (pb.StatsRequest, pb.StatsReply),
+        "AcctMgr": (pb.AcctMgrRequest, pb.AcctMgrReply),
         "CranedHealth": (pb.CranedHealthRequest, pb.OkReply),
         "CranedRegister": (pb.CranedRegisterRequest,
                            pb.CranedRegisterReply),

@@ -76,6 +76,9 @@ class CraneConfig:
     # job_submit(spec) -> spec | None (reference JobSubmitLuaScript,
     # etc/config.yaml:119)
     submit_hook_path: str = ""
+    # accounting: RootUsers bootstrap the RBAC hierarchy; empty list =
+    # accounting (and its limits) disabled — the open system
+    accounting_root_users: list = dataclasses.field(default_factory=list)
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -134,7 +137,16 @@ class CraneConfig:
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)
-        scheduler = JobScheduler(meta, config, submit_hook=hook)
+        accounts = None
+        if self.accounting_root_users:
+            from cranesched_tpu.ctld.accounting import (
+                AccountManager, AdminLevel, User)
+            accounts = AccountManager()
+            for name in self.accounting_root_users:
+                accounts.users[str(name)] = User(
+                    name=str(name), admin_level=AdminLevel.ROOT)
+        scheduler = JobScheduler(meta, config, submit_hook=hook,
+                                 accounts=accounts)
         for lic in self.licenses:
             scheduler.licenses.configure(str(lic["name"]),
                                          int(lic["total"]))
@@ -195,4 +207,6 @@ def load_config(path: str) -> CraneConfig:
         scheduler=raw.get("Scheduler", {}) or {},
         priority=raw.get("Priority", {}) or {},
         licenses=raw.get("Licenses", []) or [],
-        submit_hook_path=str(raw.get("SubmitHook", "") or ""))
+        submit_hook_path=str(raw.get("SubmitHook", "") or ""),
+        accounting_root_users=list(
+            (raw.get("Accounting") or {}).get("RootUsers", [])))

@@ -52,6 +52,10 @@ def test_crashing_or_bad_hook_rejects_not_crashes():
 
     sched2 = make_sched(wrong_type)
     assert sched2.submit(JobSpec(res=ResourceSpec(cpu=1.0)), now=0.0) == 0
+    # failures are counted so operators can see a misbehaving hook
+    sched3 = make_sched(crashing)
+    sched3.submit(JobSpec(res=ResourceSpec(cpu=1.0)), now=0.0)
+    assert sched3.stats["submit_hook_failures"] == 1
 
 
 def test_hook_path_errors_are_legible(tmp_path):
