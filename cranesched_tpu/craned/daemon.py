@@ -45,10 +45,11 @@ class CranedState(enum.Enum):
 
 class _Step:
     def __init__(self, job_id: int, proc: subprocess.Popen,
-                 incarnation: int = 0):
+                 incarnation: int = 0, gres_held=None):
         self.job_id = job_id
         self.proc = proc
         self.incarnation = incarnation
+        self.gres_held = gres_held or {}
         self.cancelled = False
 
 
@@ -59,7 +60,8 @@ class CranedDaemon:
                  ping_interval: float = 5.0,
                  cgroup_root: str = "/sys/fs/cgroup",
                  health_program: str = "",
-                 health_interval: float = 30.0):
+                 health_interval: float = 30.0,
+                 gres: dict | None = None):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -72,6 +74,18 @@ class CranedDaemon:
         self.health_program = health_program
         self.health_interval = health_interval
         self.healthy = True
+        # GRES slot identity (reference DeviceManager, DeviceManager.h:
+        # 26-80: concrete slot ids assigned at step start, vendor env
+        # injection).  Slot ids live in a node-global index space per
+        # GRES NAME (a node with gpu:a100:2 + gpu:h100:1 exposes gpu ids
+        # 0,1,2) so two types never alias the same physical device.
+        self.gres = dict(gres or {})
+        self._gres_free: dict[tuple, list[int]] = {}
+        next_id: dict[str, int] = {}
+        for (name, typ), count in sorted(self.gres.items()):
+            base = next_id.get(name, 0)
+            self._gres_free[(name, typ)] = list(range(base, base + count))
+            next_id[name] = base + count
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = CgroupV2(cgroup_root)
@@ -152,6 +166,12 @@ class CranedDaemon:
         spec = request.spec
         with self._lock:
             self._spawning.add(job_id)
+        # GRES first: nothing else to clean up if the pool can't satisfy
+        step_env = {"CRANE_JOB_NAME": spec.name,
+                    "CRANE_JOB_NODELIST": self.name}
+        gres_held = self._assign_gres(spec, step_env)
+        if gres_held is None:
+            raise RuntimeError("insufficient free GRES slots")
         procs_path = self.cgroups.create(
             job_id, cpu=spec.res.cpu, mem_bytes=spec.res.mem_bytes,
             memsw_bytes=spec.res.memsw_bytes)
@@ -172,18 +192,26 @@ class CranedDaemon:
             job_id=job_id, script=spec.script,
             output_path=spec.output_path,
             time_limit=spec.time_limit,
-            env={"CRANE_JOB_NAME": spec.name,
-                 "CRANE_JOB_NODELIST": self.name},
+            env=step_env,
             cgroup_procs=procs_path)
-        proc.stdin.write((json.dumps(init) + "\n").encode())
-        proc.stdin.flush()
-        ready = proc.stdout.readline().strip()
-        if ready != b"READY":
+        try:
+            proc.stdin.write((json.dumps(init) + "\n").encode())
+            proc.stdin.flush()
+            ready = proc.stdout.readline().strip()
+            if ready != b"READY":
+                raise RuntimeError(
+                    f"supervisor handshake failed: {ready!r}")
+            proc.stdin.write(b"GO\n")
+            proc.stdin.flush()
+        except Exception:
+            # every spawn failure must leak nothing: kill the process,
+            # free the slots, drop the cgroup
             proc.kill()
-            raise RuntimeError(f"supervisor handshake failed: {ready!r}")
-        proc.stdin.write(b"GO\n")
-        proc.stdin.flush()
-        step = _Step(job_id, proc, incarnation=request.incarnation)
+            self._release_gres(gres_held)
+            self.cgroups.destroy(job_id)
+            raise
+        step = _Step(job_id, proc, incarnation=request.incarnation,
+                     gres_held=gres_held)
         with self._lock:
             self._steps[job_id] = step
             self._spawning.discard(job_id)
@@ -195,10 +223,51 @@ class CranedDaemon:
         threading.Thread(target=self._watch_step, args=(step,),
                          daemon=True).start()
 
+    def _assign_gres(self, spec, env: dict):
+        """Pick concrete slot ids for the step's GRES request and inject
+        vendor-style env (reference DeviceManager.h:26-51 maps vendors to
+        CUDA_VISIBLE_DEVICES / HIP_VISIBLE_DEVICES / ...).  Returns the
+        held slots, or None when the local pool cannot satisfy."""
+        wanted = {}
+        for key, count in (spec.res.gres or {}).items():
+            name, _, typ = key.partition(":")
+            wanted[(name, typ)] = count
+        if not wanted:
+            return {}
+        with self._lock:
+            for pair, count in wanted.items():
+                if len(self._gres_free.get(pair, ())) < count:
+                    return None
+            held = {}
+            per_name: dict[str, list[int]] = {}
+            for pair, count in sorted(wanted.items()):
+                slots = [self._gres_free[pair].pop(0)
+                         for _ in range(count)]
+                held[pair] = slots
+                name, typ = pair
+                env[f"CRANE_GRES_{name.upper()}"
+                    + (f"_{typ.upper()}" if typ else "")] = \
+                    ",".join(map(str, slots))
+                per_name.setdefault(name, []).extend(slots)
+            if "gpu" in per_name:
+                env["CUDA_VISIBLE_DEVICES"] = \
+                    ",".join(map(str, sorted(per_name["gpu"])))
+        return held
+
+    def _release_gres(self, held: dict) -> None:
+        with self._lock:
+            for pair, slots in (held or {}).items():
+                pool = self._gres_free.setdefault(pair, [])
+                pool.extend(slots)
+                pool.sort()
+
     def _watch_step(self, step: _Step) -> None:
         """SIGCHLD/reporting path (supervisor exit -> StepStatusChange)."""
         report = step.proc.stdout.readline().strip().decode()
         step.proc.wait()
+        # the step's own slots are always returned (they belong to this
+        # incarnation, held on the step object)
+        self._release_gres(step.gres_held)
         with self._lock:
             # only clean up if the registry still points at OUR step — a
             # re-dispatched incarnation may have replaced the entry
@@ -282,13 +351,15 @@ class CranedDaemon:
 
     def _register(self) -> bool:
         try:
+            total = pb.ResourceSpec(cpu=self.cpu,
+                                    mem_bytes=self.mem_bytes,
+                                    memsw_bytes=self.mem_bytes)
+            for (name, typ), count in self.gres.items():
+                total.gres[f"{name}:{typ}"] = count
             reply = self._ctld._call(
                 "CranedRegister",
                 pb.CranedRegisterRequest(
-                    name=self.name,
-                    total=pb.ResourceSpec(cpu=self.cpu,
-                                          mem_bytes=self.mem_bytes,
-                                          memsw_bytes=self.mem_bytes),
+                    name=self.name, total=total,
                     partitions=list(self.partitions),
                     address=self.address),
                 pb.CranedRegisterReply)

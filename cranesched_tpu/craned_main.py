@@ -26,10 +26,19 @@ def main(argv=None) -> int:
     ap.add_argument("--cgroup-root", default="/sys/fs/cgroup")
     ap.add_argument("--health-program", default="")
     ap.add_argument("--health-interval", type=float, default=30.0)
+    ap.add_argument("--gres", default="",
+                    help="name[:type]:count, comma-separated")
     args = ap.parse_args(argv)
 
     from cranesched_tpu.craned.daemon import CranedDaemon
     from cranesched_tpu.utils.config import parse_mem
+
+    gres = {}
+    if args.gres:
+        from cranesched_tpu.cli import _parse_gres
+        for key, count in _parse_gres(args.gres).items():
+            name, _, typ = key.partition(":")
+            gres[(name, typ)] = count
 
     daemon = CranedDaemon(
         args.name, args.ctld, cpu=args.cpu,
@@ -38,7 +47,8 @@ def main(argv=None) -> int:
         workdir=args.workdir, ping_interval=args.ping_interval,
         cgroup_root=args.cgroup_root,
         health_program=args.health_program,
-        health_interval=args.health_interval)
+        health_interval=args.health_interval,
+        gres=gres)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

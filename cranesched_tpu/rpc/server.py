@@ -298,12 +298,22 @@ class CtldServer:
                     # refused until the operator wakes it (cnode wake)
                     return pb.CranedRegisterReply(ok=False)
             else:
+                # only GRES pairs in the cluster's configured layout can
+                # be represented; unknown pairs are ignored (the craned
+                # still tracks its local slots)
+                known = set(meta.layout.gres_dims)
+                gres = {}
+                for key, count in request.total.gres.items():
+                    name, _, typ = key.partition(":")
+                    if (name, typ) in known:
+                        gres[(name, typ)] = count
                 node = meta.add_node(
                     request.name,
                     meta.layout.encode(
                         cpu=request.total.cpu,
                         mem_bytes=request.total.mem_bytes,
                         memsw_bytes=request.total.memsw_bytes,
+                        gres=gres,
                         is_capacity=True),
                     partitions=tuple(request.partitions) or ("default",))
             meta.craned_up(node.node_id)
