@@ -30,6 +30,7 @@ from concurrent import futures
 import grpc
 
 from cranesched_tpu.craned.cgroup import CgroupV2
+from cranesched_tpu.ops.resources import gres_key_pair, gres_key_str
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.client import CtldClient
 from cranesched_tpu.rpc.consts import CRANED_SERVICE
@@ -79,7 +80,10 @@ class CranedDaemon:
         # injection).  Slot ids live in a node-global index space per
         # GRES NAME (a node with gpu:a100:2 + gpu:h100:1 exposes gpu ids
         # 0,1,2) so two types never alias the same physical device.
-        self.gres = dict(gres or {})
+        # keys normalized once: accept "name:type" strings or pairs
+        self.gres = {
+            (gres_key_pair(k) if isinstance(k, str) else tuple(k)): v
+            for k, v in (gres or {}).items()}
         self._gres_free: dict[tuple, list[int]] = {}
         next_id: dict[str, int] = {}
         for (name, typ), count in sorted(self.gres.items()):
@@ -171,7 +175,9 @@ class CranedDaemon:
                     "CRANE_JOB_NODELIST": self.name}
         gres_held = self._assign_gres(spec, step_env)
         if gres_held is None:
-            raise RuntimeError("insufficient free GRES slots")
+            # a re-dispatch can overlap the previous incarnation's
+            # teardown by a few seconds — the dispatcher retries these
+            raise RuntimeError("retryable: insufficient free GRES slots")
         procs_path = self.cgroups.create(
             job_id, cpu=spec.res.cpu, mem_bytes=spec.res.mem_bytes,
             memsw_bytes=spec.res.memsw_bytes)
@@ -204,9 +210,14 @@ class CranedDaemon:
             proc.stdin.write(b"GO\n")
             proc.stdin.flush()
         except Exception:
-            # every spawn failure must leak nothing: kill the process,
+            # every spawn failure must leak nothing: kill AND REAP the
+            # process (the cgroup rmdir races a dying member otherwise),
             # free the slots, drop the cgroup
             proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
             self._release_gres(gres_held)
             self.cgroups.destroy(job_id)
             raise
@@ -228,10 +239,8 @@ class CranedDaemon:
         vendor-style env (reference DeviceManager.h:26-51 maps vendors to
         CUDA_VISIBLE_DEVICES / HIP_VISIBLE_DEVICES / ...).  Returns the
         held slots, or None when the local pool cannot satisfy."""
-        wanted = {}
-        for key, count in (spec.res.gres or {}).items():
-            name, _, typ = key.partition(":")
-            wanted[(name, typ)] = count
+        wanted = {gres_key_pair(key): count
+                  for key, count in (spec.res.gres or {}).items()}
         if not wanted:
             return {}
         with self._lock:
@@ -354,8 +363,8 @@ class CranedDaemon:
             total = pb.ResourceSpec(cpu=self.cpu,
                                     mem_bytes=self.mem_bytes,
                                     memsw_bytes=self.mem_bytes)
-            for (name, typ), count in self.gres.items():
-                total.gres[f"{name}:{typ}"] = count
+            for pair, count in self.gres.items():
+                total.gres[gres_key_str(pair)] = count
             reply = self._ctld._call(
                 "CranedRegister",
                 pb.CranedRegisterRequest(

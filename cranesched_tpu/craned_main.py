@@ -36,9 +36,7 @@ def main(argv=None) -> int:
     gres = {}
     if args.gres:
         from cranesched_tpu.cli import _parse_gres
-        for key, count in _parse_gres(args.gres).items():
-            name, _, typ = key.partition(":")
-            gres[(name, typ)] = count
+        gres = _parse_gres(args.gres)  # daemon normalizes string keys
 
     daemon = CranedDaemon(
         args.name, args.ctld, cpu=args.cpu,

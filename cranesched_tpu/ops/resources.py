@@ -51,6 +51,18 @@ CPU_SCALE = 256
 # Memory unit for device tensors.
 MEM_UNIT_BYTES = 1 << 20  # 1 MiB
 
+def gres_key_str(pair) -> str:
+    """Canonical wire form of a GRES (name, type) pair: "name:type"."""
+    name, typ = pair
+    return f"{name}:{typ}"
+
+
+def gres_key_pair(key: str) -> tuple:
+    """Inverse of gres_key_str."""
+    name, _, typ = key.partition(":")
+    return (name, typ)
+
+
 DIM_CPU = 0
 DIM_MEM = 1
 DIM_MEMSW = 2

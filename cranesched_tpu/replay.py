@@ -1,0 +1,238 @@
+"""Replay harness: the five BASELINE.json benchmark configurations as
+runnable end-to-end workloads (SURVEY.md §7 artifact 3 — "trace
+generators for the five BASELINE.json configs, differential tests").
+
+Each config builds a cluster + job trace, drives it through the full
+control plane on the simulated node plane (virtual clock — drain time is
+measured in cycles, not wall seconds), and reports scheduling metrics:
+
+    python -m cranesched_tpu.replay fifo --scale 0.1
+    python -m cranesched_tpu.replay all --scale 0.02 --json
+
+Configs (full-scale shapes from BASELINE.md):
+  fifo        FIFO, 10k jobs x 1k nodes, cpu+mem
+  minload     MinCpuTimeRatioFirst order, 50k jobs x 5k nodes,
+              multi-partition
+  backfill    priority + backfill around long blockers
+  gres        GRES gang jobs (gpu slots + multi-node gangs)
+  qos         QoS/fair-share mix with run limits (scaled from the 1M
+              trace shape)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build(num_nodes, cpu, mem_gb, layout_gres=(), partitions=("default",),
+           accounts=None, config_kw=None):
+    from cranesched_tpu.craned.sim import SimCluster
+    from cranesched_tpu.ctld.meta import MetaContainer
+    from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
+    from cranesched_tpu.ops.resources import ResourceLayout
+
+    meta = MetaContainer(ResourceLayout.from_gres_names(list(layout_gres)))
+    for i in range(num_nodes):
+        part = partitions[i % len(partitions)]
+        gres = ({("gpu", "a100"): 4} if layout_gres and i % 2 == 0
+                else None)
+        meta.add_node(
+            f"n{i:05d}",
+            meta.layout.encode(cpu=cpu, mem_bytes=mem_gb << 30,
+                               memsw_bytes=mem_gb << 30, gres=gres,
+                               is_capacity=True),
+            partitions=(part,))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(**(config_kw or {})),
+                         accounts=accounts)
+    sim = SimCluster(sched)
+    sched.dispatch = sim.dispatch
+    sched.dispatch_terminate = sim.terminate
+    return meta, sched, sim
+
+
+def _drain(sched, sim, max_cycles=100_000):
+    t0 = time.perf_counter()
+    end = sim.run_until_drained(start=0.0, max_cycles=max_cycles)
+    wall = time.perf_counter() - t0
+    total = len(sched.history)
+    return dict(
+        jobs_finished=total,
+        completed=sum(1 for j in sched.history.values()
+                      if j.status.value == "Completed"),
+        virtual_drain_s=end,
+        wall_s=round(wall, 3),
+        cycles=sched.stats["cycles"],
+        jobs_per_wall_s=round(total / wall, 1) if wall else 0.0,
+    )
+
+
+def replay_fifo(scale: float, rng):
+    """BASELINE config #1: FIFO 10k jobs x 1k nodes (cpu+mem)."""
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    n_nodes = max(int(1000 * scale), 4)
+    n_jobs = max(int(10_000 * scale), 20)
+    meta, sched, sim = _build(
+        n_nodes, cpu=16, mem_gb=64,
+        config_kw=dict(priority_type="basic", backfill=False))
+    for _ in range(n_jobs):
+        sched.submit(JobSpec(
+            res=ResourceSpec(cpu=float(rng.integers(1, 9)),
+                             mem_bytes=int(rng.integers(1, 17)) << 30,
+                             memsw_bytes=int(rng.integers(1, 17)) << 30),
+            time_limit=3600,
+            sim_runtime=float(rng.integers(10, 300))), now=0.0)
+    return _drain(sched, sim)
+
+
+def replay_minload(scale: float, rng):
+    """BASELINE config #2: MinCpuTimeRatioFirst, 50k x 5k,
+    multi-partition."""
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    n_nodes = max(int(5000 * scale), 8)
+    n_jobs = max(int(50_000 * scale), 40)
+    parts = ("alpha", "beta", "gamma")
+    meta, sched, sim = _build(
+        n_nodes, cpu=32, mem_gb=128, partitions=parts,
+        config_kw=dict(priority_type="multifactor", backfill=False))
+    for _ in range(n_jobs):
+        sched.submit(JobSpec(
+            partition=parts[int(rng.integers(0, len(parts)))],
+            res=ResourceSpec(cpu=float(rng.integers(1, 17)),
+                             mem_bytes=int(rng.integers(1, 33)) << 30,
+                             memsw_bytes=int(rng.integers(1, 33)) << 30),
+            qos_priority=int(rng.integers(0, 4)) * 100,
+            time_limit=7200,
+            sim_runtime=float(rng.integers(30, 600))), now=0.0)
+    return _drain(sched, sim)
+
+
+def replay_backfill(scale: float, rng):
+    """BASELINE config #3: priority + backfill — short jobs around
+    long high-priority blockers."""
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    n_nodes = max(int(500 * scale), 4)
+    n_jobs = max(int(5000 * scale), 30)
+    meta, sched, sim = _build(
+        n_nodes, cpu=16, mem_gb=64,
+        config_kw=dict(priority_type="multifactor", backfill=True,
+                       time_resolution=60.0, time_buckets=32))
+    for i in range(n_jobs):
+        big = i % 10 == 0
+        sched.submit(JobSpec(
+            res=ResourceSpec(cpu=16.0 if big else
+                             float(rng.integers(1, 5)),
+                             mem_bytes=(32 if big else 2) << 30,
+                             memsw_bytes=(32 if big else 2) << 30),
+            qos_priority=1000 if big else 0,
+            time_limit=1800 if big else 300,
+            sim_runtime=float(rng.integers(600, 1800)) if big
+            else float(rng.integers(10, 120))), now=0.0)
+    return _drain(sched, sim)
+
+
+def replay_gres(scale: float, rng):
+    """BASELINE config #4: GRES gang jobs (gpu slots, multi-node)."""
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    n_nodes = max(int(1000 * scale), 8)
+    n_jobs = max(int(5000 * scale), 30)
+    meta, sched, sim = _build(
+        n_nodes, cpu=32, mem_gb=128, layout_gres=[("gpu", "a100")],
+        config_kw=dict(priority_type="multifactor", backfill=False,
+                       max_nodes_per_job=4))
+    for _ in range(n_jobs):
+        wants_gpu = rng.random() < 0.4
+        sched.submit(JobSpec(
+            res=ResourceSpec(
+                cpu=float(rng.integers(1, 9)),
+                mem_bytes=int(rng.integers(1, 17)) << 30,
+                memsw_bytes=int(rng.integers(1, 17)) << 30,
+                gres=({("gpu", "a100"): int(rng.integers(1, 5))}
+                      if wants_gpu else None)),
+            node_num=int(rng.integers(1, 4)) if rng.random() < 0.2
+            else 1,
+            time_limit=3600,
+            sim_runtime=float(rng.integers(30, 300))), now=0.0)
+    return _drain(sched, sim)
+
+
+def replay_qos(scale: float, rng):
+    """BASELINE config #5 (scaled from the 1M x 100k trace shape):
+    QoS/fair-share mix with run limits across accounts."""
+    from cranesched_tpu.ctld.accounting import (
+        Account, AccountManager, AdminLevel, Qos, User)
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="high", priority=1000,
+                            max_jobs_per_user=64))
+    mgr.add_qos("root", Qos(name="low", priority=0,
+                            max_jobs_per_user=32))
+    for acc in ("physics", "biology", "ml"):
+        mgr.add_account("root", Account(
+            name=acc, allowed_qos={"high", "low"}, default_qos="low"))
+        for u in range(3):
+            mgr.add_user("root", User(name=f"{acc}-u{u}",
+                                      uid=1000 + u), acc)
+    n_nodes = max(int(1000 * scale), 8)
+    n_jobs = max(int(20_000 * scale), 60)
+    meta, sched, sim = _build(
+        n_nodes, cpu=16, mem_gb=64, accounts=mgr,
+        config_kw=dict(priority_type="multifactor", backfill=False))
+    accounts = ("physics", "biology", "ml")
+    for _ in range(n_jobs):
+        acc = accounts[int(rng.integers(0, 3))]
+        sched.submit(JobSpec(
+            user=f"{acc}-u{int(rng.integers(0, 3))}", account=acc,
+            qos="high" if rng.random() < 0.2 else "low",
+            res=ResourceSpec(cpu=float(rng.integers(1, 5)),
+                             mem_bytes=int(rng.integers(1, 9)) << 30,
+                             memsw_bytes=int(rng.integers(1, 9)) << 30),
+            time_limit=1800,
+            sim_runtime=float(rng.integers(10, 120))), now=0.0)
+    return _drain(sched, sim, max_cycles=200_000)
+
+
+CONFIGS = {
+    "fifo": replay_fifo,
+    "minload": replay_minload,
+    "backfill": replay_backfill,
+    "gres": replay_gres,
+    "qos": replay_qos,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="crane-replay")
+    ap.add_argument("config", choices=[*CONFIGS, "all"])
+    ap.add_argument("--scale", type=float, default=0.1,
+                    help="fraction of the full BASELINE shape")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    names = list(CONFIGS) if args.config == "all" else [args.config]
+    results = {}
+    for name in names:
+        rng = np.random.default_rng(args.seed)
+        results[name] = CONFIGS[name](args.scale, rng)
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for name, r in results.items():
+            print(f"{name:9s} finished={r['jobs_finished']} "
+                  f"completed={r['completed']} "
+                  f"cycles={r['cycles']} "
+                  f"virtual_drain={r['virtual_drain_s']:.0f}s "
+                  f"wall={r['wall_s']}s "
+                  f"({r['jobs_per_wall_s']} jobs/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

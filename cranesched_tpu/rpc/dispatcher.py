@@ -74,14 +74,25 @@ class GrpcDispatcher:
             stub = self._stub(node_id)
             if stub is None:
                 return f"node {node_id} has no stub"
-            try:
-                reply = stub.call("ExecuteStep", pb.ExecuteStepRequest(
-                    job_id=job.job_id, spec=spec_pb,
-                    tasks_on_node=ntasks, now=time.time(),
-                    incarnation=job.requeue_count))
-                return "" if reply.ok else reply.error
-            except grpc.RpcError as exc:
-                return f"push to node {node_id} failed: {exc.code()}"
+            # transient refusals (e.g. GRES slots still held by a
+            # previous incarnation mid-teardown) retry briefly
+            for attempt in range(10):
+                try:
+                    reply = stub.call("ExecuteStep",
+                                      pb.ExecuteStepRequest(
+                                          job_id=job.job_id,
+                                          spec=spec_pb,
+                                          tasks_on_node=ntasks,
+                                          now=time.time(),
+                                          incarnation=job.requeue_count))
+                except grpc.RpcError as exc:
+                    return f"push to node {node_id} failed: {exc.code()}"
+                if reply.ok:
+                    return ""
+                if not reply.error.startswith("retryable:"):
+                    return reply.error
+                time.sleep(0.5)
+            return reply.error
 
         def fan_out():
             errors = [e for e in map(push, node_ids,

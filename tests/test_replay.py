@@ -1,0 +1,26 @@
+"""The replay harness runs all five BASELINE configurations end to end
+at CI scale (SURVEY §7 artifact 3)."""
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.replay import CONFIGS
+
+
+@pytest.mark.parametrize("name", list(CONFIGS))
+def test_replay_config_drains(name):
+    rng = np.random.default_rng(0)
+    result = CONFIGS[name](0.01, rng)
+    assert result["jobs_finished"] > 0
+    # every job reaches a terminal state and the vast majority complete
+    assert result["completed"] >= result["jobs_finished"] * 0.95
+    assert result["cycles"] > 0
+
+
+def test_replay_cli_json(capsys):
+    from cranesched_tpu import replay
+    rc = replay.main(["fifo", "--scale", "0.005", "--json"])
+    assert rc == 0
+    import json
+    out = json.loads(capsys.readouterr().out)
+    assert out["fifo"]["jobs_finished"] >= 20
