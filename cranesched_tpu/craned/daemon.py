@@ -98,9 +98,13 @@ class CranedDaemon:
         # kills that race an in-flight spawn handshake: recorded only
         # while a spawn for that job is actually in progress (a kill for
         # a step that already finished is a no-op and must NOT poison a
-        # future re-dispatch of the same job id)
-        self._spawning: set[int] = set()
-        self._pending_kills: set[int] = set()
+        # future re-dispatch of the same job id).  Keyed with the
+        # spawning incarnation so an incarnation-guarded kill can be
+        # matched against the spawn it was aimed at; the latch value is
+        # the guarded incarnation, or None for a wildcard (user-cancel)
+        # kill.  A wildcard latch subsumes any guarded one.
+        self._spawning: dict[int, int] = {}
+        self._pending_kills: dict[int, int | None] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
@@ -116,21 +120,43 @@ class CranedDaemon:
             return pb.OkReply(ok=False, error=str(exc))
         finally:
             with self._lock:
-                self._spawning.discard(request.job_id)
-                self._pending_kills.discard(request.job_id)
+                # only clear OUR spawn record: a slow stale-incarnation
+                # handler must not clobber the record (and latched kill)
+                # of a newer incarnation's in-flight spawn
+                if self._spawning.get(request.job_id) == \
+                        request.incarnation:
+                    self._spawning.pop(request.job_id, None)
+                    # drop only a latch aimed at our (now finished) spawn
+                    # — wildcard included: the kill was a no-op against a
+                    # step that never registered, and a future
+                    # re-dispatch must not be poisoned
+                    self._pending_kills.pop(request.job_id, None)
 
     def TerminateStep(self, request, context):
+        guard = (request.incarnation if request.HasField("incarnation")
+                 else None)
         with self._lock:
             step = self._steps.get(request.job_id)
-            if step is None:
-                if request.job_id in self._spawning:
-                    # the kill raced an in-flight ExecuteStep handshake:
-                    # apply it the moment the step registers
-                    self._pending_kills.add(request.job_id)
+            if step is not None and (guard is None
+                                     or guard == step.incarnation):
+                step.cancelled = True
+            else:
+                # no registered step of the targeted incarnation — maybe
+                # the kill raced an in-flight ExecuteStep handshake for
+                # it: latch so it applies the moment the step registers.
+                # (Checked even when a DIFFERENT incarnation's step is
+                # registered: a stale step can coexist with the new
+                # incarnation's spawn on the same node.)
+                spawn_inc = self._spawning.get(request.job_id)
+                if spawn_inc is not None and (guard is None
+                                              or guard == spawn_inc):
+                    # a wildcard latch (None) subsumes any guarded one
+                    if self._pending_kills.get(request.job_id,
+                                               "absent") is not None:
+                        self._pending_kills[request.job_id] = guard
                 # else: the step already finished (or never started) —
                 # the kill is a no-op
                 return pb.OkReply(ok=True)
-            step.cancelled = True
         self._send_verb(step, "TERM")
         return pb.OkReply(ok=True)
 
@@ -169,7 +195,7 @@ class CranedDaemon:
         job_id = request.job_id
         spec = request.spec
         with self._lock:
-            self._spawning.add(job_id)
+            self._spawning[job_id] = request.incarnation
         # GRES first: nothing else to clean up if the pool can't satisfy
         step_env = {"CRANE_JOB_NAME": spec.name,
                     "CRANE_JOB_NODELIST": self.name}
@@ -224,10 +250,35 @@ class CranedDaemon:
         step = _Step(job_id, proc, incarnation=request.incarnation,
                      gres_held=gres_held)
         with self._lock:
-            self._steps[job_id] = step
-            self._spawning.discard(job_id)
-            killed_already = job_id in self._pending_kills
-            self._pending_kills.discard(job_id)
+            existing = self._steps.get(job_id)
+            # a slow stale spawn must not clobber an already-registered
+            # NEWER incarnation (incarnations only grow); conversely,
+            # registering over an older stale step evicts it
+            stale_self = (existing is not None
+                          and existing.incarnation > request.incarnation)
+            if not stale_self:
+                self._steps[job_id] = step
+            if self._spawning.get(job_id) == request.incarnation:
+                self._spawning.pop(job_id, None)
+            # consume a latched kill only if it was aimed at US (guarded
+            # with our incarnation) or at whatever runs (wildcard None) —
+            # a kill latched for a different concurrent spawn stays
+            lat = self._pending_kills.get(job_id, "absent")
+            killed_already = (not stale_self and lat != "absent"
+                              and (lat is None
+                                   or lat == request.incarnation))
+            if killed_already:
+                self._pending_kills.pop(job_id, None)
+        if stale_self:
+            # ctld has moved past this incarnation: kill our own spawn
+            step.cancelled = True
+            self._send_verb(step, "TERM")
+        elif existing is not None:
+            # we evicted an older registered step: kill it too (its
+            # watcher sees the registry no longer points at it and will
+            # neither pop our entry nor destroy the shared cgroup)
+            existing.cancelled = True
+            self._send_verb(existing, "TERM")
         if killed_already:
             step.cancelled = True
             self._send_verb(step, "TERM")

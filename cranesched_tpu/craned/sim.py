@@ -111,17 +111,29 @@ class SimCluster:
         heapq.heappush(self._events, _Completion(
             now + remaining, job_id, status, exit_code, rqc))
 
-    def terminate(self, job_id: int, now: float | None = None) -> None:
+    def terminate(self, job_id: int, now: float | None = None,
+                  incarnation: int | None = None,
+                  skip_node: int | None = None) -> None:
         """TerminateSteps analog: immediate kill + Cancelled upcall.
-        ``now`` is the ctld-side cancel time (the cluster clock may lag)."""
+        ``now`` is the ctld-side cancel time (the cluster clock may lag).
+        ``incarnation`` guards the kill (stale system kills must miss a
+        re-placed run); ``skip_node`` is irrelevant here (the sim kills
+        the whole job atomically)."""
         job = self.scheduler.running.get(job_id)
         if job is None:
+            return
+        if incarnation is not None and job.requeue_count != incarnation:
             return
         when = self.now if now is None else max(now, self.now)
         self._frozen.pop(job_id, None)
         self._remove_step_everywhere(job_id)
+        # stamp the incarnation we killed: ctld may requeue + re-place the
+        # job before this report drains (e.g. on_craned_down terminates
+        # the gang then requeues in the same call) and the stale Cancelled
+        # must not finalize the new incarnation
         self.scheduler.step_status_change(job_id, JobStatus.CANCELLED,
-                                          130, when)
+                                          130, when,
+                                          incarnation=job.requeue_count)
 
     # -- clock --
 
@@ -139,7 +151,8 @@ class SimCluster:
                 continue
             self._remove_step_everywhere(ev.job_id)
             self.scheduler.step_status_change(ev.job_id, ev.status,
-                                              ev.exit_code, ev.time)
+                                              ev.exit_code, ev.time,
+                                              incarnation=ev.requeue_count)
             sent += 1
         return sent
 

@@ -123,6 +123,11 @@ class StatusChange:
     status: JobStatus
     exit_code: int
     time: float
+    # incarnation (requeue_count) the report belongs to; None = trust the
+    # caller (pre-aggregated).  A report queued for incarnation k must not
+    # finalize incarnation k+1 — a node death can requeue + re-place the
+    # job between the enqueue and the drain.
+    incarnation: int | None = None
 
 
 class JobScheduler:
@@ -162,6 +167,8 @@ class JobScheduler:
         self._mask_cache: dict[tuple, np.ndarray] = {}
         self._mask_cache_epoch = -1
         self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
+        # job_id -> last kill-send time for unconfirmed cancel intents
+        self._cancel_kill_sent: dict[int, float] = {}
         # observability (reference per-phase wall-clock trace,
         # JobScheduler.cpp:1444-1447,1723-1903)
         self.stats = {
@@ -232,10 +239,12 @@ class JobScheduler:
         if spec.ntasks is not None:
             nt_max = max(spec.ntasks_per_node_max,
                          spec.ntasks_per_node_min)
-            if not (spec.node_num <= spec.ntasks
-                    <= spec.node_num * nt_max):
-                return 0  # every node hosts >= 1 task and the gang's
-                          # combined per-node cap must cover ntasks
+            nt_min = spec.ntasks_per_node_min
+            if not (max(spec.node_num, spec.node_num * nt_min)
+                    <= spec.ntasks <= spec.node_num * nt_max):
+                return 0  # every chosen node must host at least
+                          # ntasks_per_node_min tasks (>= 1) and the
+                          # gang's combined per-node cap must cover ntasks
 
         if spec.reservation:
             resv = self.meta.reservations.get(spec.reservation)
@@ -372,10 +381,7 @@ class JobScheduler:
                 job.array_remaining = []
                 for c in list(job.array_children):
                     self.cancel(c, now)
-            self._finalize(job)
-            self._trigger_dep_event(job)
-            if job.array_parent_id is not None:
-                self._on_array_child_terminal(job)
+            self._finalize_terminal(job)
             return True
         if job_id in self.running:
             # real system: TerminateSteps RPC → craned kills → status
@@ -387,13 +393,23 @@ class JobScheduler:
             job.cancel_requested = True
             if self.wal is not None:
                 self.wal.job_updated(job)
+            self._cancel_kill_sent[job_id] = now
             self.dispatch_terminate(job_id, now)
             return True
         return False
 
-    def dispatch_terminate(self, job_id: int, now: float) -> None:
+    def dispatch_terminate(self, job_id: int, now: float,
+                           incarnation: int | None = None,
+                           skip_node: int | None = None) -> None:
         """Overridden/patched by the transport layer; simulated clusters
-        hook this to deliver a Cancelled status change."""
+        hook this to deliver a Cancelled status change.
+
+        ``incarnation`` guards the kill to exactly that requeue_count
+        (system-initiated kills that are followed by a same-cycle requeue
+        must never touch the re-placed incarnation); None = user intent,
+        kill whatever runs.  ``skip_node`` omits a node already declared
+        dead (its steps died with the daemon; an RPC to it only blocks a
+        pool worker for the full timeout)."""
 
     def hold(self, job_id: int, held: bool, now: float) -> bool:
         job = self.pending.get(job_id)
@@ -419,6 +435,7 @@ class JobScheduler:
         is terminal only when every allocated node reported (or on the
         first failure, which kills the rest).  node_id == -1 is a
         whole-job report (simulated plane / dispatch failures)."""
+        queue_incarnation = incarnation
         if node_id >= 0:
             job = self.running.get(job_id)
             if job is None:
@@ -441,8 +458,11 @@ class JobScheduler:
             job.node_reports[node_id] = (status, exit_code)
             if is_failure and not had_failure:
                 # first failure: kill the remaining steps; their
-                # Cancelled reports complete the set
-                self.dispatch_terminate(job_id, now)
+                # Cancelled reports complete the set.  Guarded by this
+                # incarnation — if the job requeues before the async kill
+                # lands, the new run must survive it.
+                self.dispatch_terminate(job_id, now,
+                                        incarnation=job.requeue_count)
             if not all(n in job.node_reports for n in job.node_ids):
                 return
             # aggregate: worst status wins (any non-complete -> that)
@@ -466,8 +486,10 @@ class JobScheduler:
                          for st, _ in job.node_reports.values()):
                     agg_status, agg_code = JobStatus.CANCELLED, 130
             status, exit_code = agg_status, agg_code
+            queue_incarnation = job.requeue_count
         self._status_queue.append(
-            StatusChange(job_id, status, exit_code, now))
+            StatusChange(job_id, status, exit_code, now,
+                         incarnation=queue_incarnation))
 
     def process_status_changes(self) -> int:
         """Drain the queue (cycle step 1).  Returns #processed."""
@@ -477,9 +499,13 @@ class JobScheduler:
             job = self.running.get(ch.job_id)
             if job is None:
                 continue
+            if (ch.incarnation is not None
+                    and ch.incarnation != job.requeue_count):
+                continue  # stale report for a pre-requeue incarnation
             n += 1
             self._release_job_resources(job)
             del self.running[ch.job_id]
+            self._cancel_kill_sent.pop(ch.job_id, None)
             job.end_time = ch.time
             job.exit_code = ch.exit_code
             job.status = ch.status
@@ -494,10 +520,7 @@ class JobScheduler:
                 if self.wal is not None:
                     self.wal.job_requeued(job)
             else:
-                self._finalize(job)
-                self._trigger_dep_event(job)
-                if job.array_parent_id is not None:
-                    self._on_array_child_terminal(job)
+                self._finalize_terminal(job)
         return n
 
     def _should_requeue(self, job: Job, ch: StatusChange) -> bool:
@@ -562,6 +585,17 @@ class JobScheduler:
             self.account_meta.free_run(job.spec.user, job.spec.account,
                                        job.qos_name, job.spec)
             job.run_usage_taken = False
+
+    def _finalize_terminal(self, job: Job) -> None:
+        """Full terminal processing: archive + fire dependency events +
+        array-parent bookkeeping.  Every path that moves a job to a
+        terminal state outside process_status_changes must use this (a
+        bare _finalize drops the event hooks and dependents would wait
+        forever — dependency edges are event-driven, never polled)."""
+        self._finalize(job)
+        self._trigger_dep_event(job)
+        if job.array_parent_id is not None:
+            self._on_array_child_terminal(job)
 
     def _finalize(self, job: Job) -> None:
         self.stats["jobs_finished_total"] += 1
@@ -630,14 +664,29 @@ class JobScheduler:
             job = self.running.get(job_id)
             if job is None:
                 continue
+            # Kill the gang's steps on SURVIVING nodes before freeing the
+            # resources (reference TerminateJobsOnCraned): without this a
+            # multi-node job's live steps keep running while ctld re-places
+            # work onto those nodes — orphaned workload + physical
+            # oversubscription.  The node list is captured synchronously by
+            # the dispatcher, so this must precede the running-map removal.
+            # Incarnation-guarded (the requeue below bumps requeue_count;
+            # an async kill racing the re-dispatch must miss the new run)
+            # and skipping the dead node (RPCs to it only burn a worker).
+            if len(job.node_ids) > 1:
+                self.dispatch_terminate(job_id, now,
+                                        incarnation=job.requeue_count,
+                                        skip_node=node_id)
             self._release_job_resources(job)
             del self.running[job_id]
+            self._cancel_kill_sent.pop(job_id, None)
             if job.cancel_requested:
                 # the kill we sent can no longer be confirmed; honor the
                 # user's cancel instead of resurrecting the job
                 job.status = JobStatus.CANCELLED
                 job.end_time = now
-                self._finalize(job)
+                job.exit_code = 130
+                self._finalize_terminal(job)
                 continue
             job.reset_for_requeue()
             if job.requeue_count > self.config.max_requeue_count:
@@ -649,6 +698,34 @@ class JobScheduler:
             if self.wal is not None:
                 self.wal.job_requeued(job)
         return victim_ids
+
+    # minimum seconds between kill re-sends for one unconfirmed cancel:
+    # each renewal is a full terminate fan-out whose RPCs can block up to
+    # their timeout on an unresponsive craned, so renewing every 1 Hz
+    # cycle would pile tasks onto the dispatcher pool faster than they
+    # drain and starve healthy dispatches behind terminate retries
+    CANCEL_RENEW_INTERVAL = 5.0
+
+    def _renew_cancel_intents(self, now: float) -> None:
+        """Re-send the kill for running jobs whose cancel intent is still
+        unconfirmed.  A TerminateStep that reaches a craned before its
+        ExecuteStep (both async on separate workers) is a no-op there, so
+        a single kill can be lost and the cancelled job would run to
+        completion; the intent is durable on the job, so re-dispatching
+        (with backoff) until the Cancelled status change arrives closes
+        the race (idempotent on the craned side)."""
+        # keyed on the outstanding-cancel map (sized by cancels in
+        # flight), NOT the running map — the latter would add an
+        # O(running) scan to every cycle's prelude
+        for job_id, last in list(self._cancel_kill_sent.items()):
+            job = self.running.get(job_id)
+            if job is None or not job.cancel_requested:
+                self._cancel_kill_sent.pop(job_id, None)
+                continue
+            if now - last < self.CANCEL_RENEW_INTERVAL:
+                continue
+            self._cancel_kill_sent[job_id] = now
+            self.dispatch_terminate(job_id, now)
 
     # ------------------------------------------------------------------
     # THE scheduling cycle (reference ScheduleThread_ :1321-1981)
@@ -663,6 +740,7 @@ class JobScheduler:
         t0 = _time.perf_counter()
         self.process_status_changes()
         self._check_craned_timeouts(now)
+        self._renew_cancel_intents(now)
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
         t_prelude = _time.perf_counter()
@@ -915,8 +993,7 @@ class JobScheduler:
                 if all(st == JobStatus.COMPLETED for st in statuses)
                 else JobStatus.FAILED)
             parent.end_time = child.end_time
-            self._finalize(parent)
-            self._trigger_dep_event(parent)
+            self._finalize_terminal(parent)
 
     # ------------------------------------------------------------------
     # QoS preemption (reference TryPreempt_, JobScheduler.cpp:6378-6505:
@@ -1023,9 +1100,20 @@ class JobScheduler:
         victim = self.running.get(victim_id)
         if victim is None:
             return
-        self.dispatch_terminate(victim_id, now)
+        self.dispatch_terminate(victim_id, now,
+                                incarnation=victim.requeue_count)
         self._release_job_resources(victim)
         del self.running[victim_id]
+        self._cancel_kill_sent.pop(victim_id, None)
+        if victim.cancel_requested:
+            # the user already cancelled this job (kill in flight); honor
+            # the cancel instead of resurrecting it as PREEMPTED — same
+            # contract as the on_craned_down path
+            victim.status = JobStatus.CANCELLED
+            victim.end_time = now
+            victim.exit_code = 130
+            self._finalize_terminal(victim)
+            return
         if self.config.preempt_mode == "requeue":
             victim.reset_for_requeue()
             victim.pending_reason = PendingReason.PREEMPTED
@@ -1041,8 +1129,7 @@ class JobScheduler:
             victim.status = JobStatus.CANCELLED
             victim.end_time = now
             victim.exit_code = 143
-            self._finalize(victim)
-            self._trigger_dep_event(victim)
+            self._finalize_terminal(victim)
 
     def _check_craned_timeouts(self, now: float) -> None:
         """Ping-miss failure detection (reference ping FSM + CranedDown,
@@ -1348,7 +1435,9 @@ class JobScheduler:
                     self.running[job_id] = job
                     if job.cancel_requested:
                         # the kill may have been lost with the crash;
-                        # re-send it
+                        # re-send it (seeding the renewal map so the
+                        # cycle keeps retrying until confirmed)
+                        self._cancel_kill_sent[job_id] = now
                         self.dispatch_terminate(job_id, now)
                 else:
                     # node vanished while we were down -> requeue, unless

@@ -151,7 +151,12 @@ class WriteAheadLog:
     """Append-only event log; each event carries the job's full runtime
     record so replay is last-writer-wins per job_id."""
 
-    def __init__(self, path: str, fsync: bool = False):
+    def __init__(self, path: str, fsync: bool = True):
+        """``fsync`` defaults to True: the daemon path must not lose
+        acknowledged submits/status transitions to a host crash (the
+        reference's embedded WAL writes before dispatch).  Tests and
+        benchmarks that only need crash-*process* durability may pass
+        fsync=False."""
         self.path = path
         self.fsync = fsync
         self._fh: IO[str] = open(path, "a", encoding="utf-8")

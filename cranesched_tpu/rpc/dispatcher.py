@@ -69,6 +69,12 @@ class GrpcDispatcher:
         Failed on dispatch errors, JobScheduler.cpp:1908-1967)."""
         spec_pb = spec_to_pb(job.spec)
         tasks = job.task_layout or [1] * len(node_ids)
+        # capture the incarnation NOW, synchronously under the ctld lock:
+        # the async fan_out below can outlive a requeue (node death while
+        # a push blocks on its RPC timeout), and a stale failure report
+        # stamped with the job's *current* requeue_count would defeat the
+        # staleness guard and kill the healthy new incarnation
+        incarnation = job.requeue_count
 
         def push(node_id, ntasks):
             stub = self._stub(node_id)
@@ -84,7 +90,7 @@ class GrpcDispatcher:
                                           spec=spec_pb,
                                           tasks_on_node=ntasks,
                                           now=time.time(),
-                                          incarnation=job.requeue_count))
+                                          incarnation=incarnation))
                 except grpc.RpcError as exc:
                     return f"push to node {node_id} failed: {exc.code()}"
                 if reply.ok:
@@ -98,21 +104,29 @@ class GrpcDispatcher:
             errors = [e for e in map(push, node_ids,
                                      tasks[: len(node_ids)]) if e]
             if errors:
-                # kill any step that did start, then report failure
+                # kill any step that did start — guarded by OUR
+                # incarnation, so if the job was requeued and re-placed
+                # while a push blocked on its RPC timeout, this late
+                # cleanup cannot kill the healthy new incarnation
                 for node_id in node_ids:
                     self._try_call(node_id, "TerminateStep",
-                                   pb.JobIdRequest(job_id=job.job_id))
+                                   pb.JobIdRequest(job_id=job.job_id,
+                                                   incarnation=incarnation))
                 self.scheduler.step_status_change(
-                    job.job_id, JobStatus.FAILED, 254, time.time())
+                    job.job_id, JobStatus.FAILED, 254, time.time(),
+                    incarnation=incarnation)
 
         self._pool.submit(fan_out)
 
-    def terminate(self, job_id: int, now: float) -> None:
-        nodes = self._job_nodes(job_id)
+    def terminate(self, job_id: int, now: float,
+                  incarnation: int | None = None,
+                  skip_node: int | None = None) -> None:
+        nodes = [n for n in self._job_nodes(job_id) if n != skip_node]
+        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation)
+               if incarnation is not None
+               else pb.JobIdRequest(job_id=job_id))
         self._pool.submit(lambda: [
-            self._try_call(n, "TerminateStep",
-                           pb.JobIdRequest(job_id=job_id))
-            for n in nodes])
+            self._try_call(n, "TerminateStep", req) for n in nodes])
 
     def suspend(self, job_id: int, now: float) -> None:
         nodes = self._job_nodes(job_id)

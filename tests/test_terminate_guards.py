@@ -1,0 +1,139 @@
+"""Incarnation-guarded terminate semantics.
+
+A system-initiated kill (node death, preemption-eviction, first-failure
+gang kill) is always followed by a requeue of the same job id; because
+kills travel async on the dispatcher pool, a late kill could otherwise
+land on the requeued incarnation's healthy steps.  These tests pin the
+guard contract end to end:
+
+* the scheduler stamps system kills with the pre-requeue incarnation and
+  skips the dead node;
+* the sim transport honors the guard;
+* stale whole-job status reports cannot finalize a newer incarnation.
+
+(reference: TerminateJobsOnCraned, JobScheduler.h:1076; the reference's
+serialization makes the window impossible there — our async dispatch
+re-creates it, hence the explicit token.)
+"""
+
+import pytest
+
+from cranesched_tpu.ctld.defs import JobSpec, JobStatus, ResourceSpec
+from cranesched_tpu.ctld.meta import MetaContainer
+from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
+from cranesched_tpu.craned.sim import SimCluster
+
+
+def make(num_nodes=4, cpu=16.0):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"n{i}", meta.layout.encode(
+            cpu=cpu, mem_bytes=32 << 30, memsw_bytes=32 << 30,
+            is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    return meta, sched
+
+
+def test_craned_down_kill_is_incarnation_guarded_and_skips_dead_node():
+    meta, sched = make()
+    kills = []
+    sched.dispatch = lambda job, nodes: None
+    sched.dispatch_terminate = \
+        lambda jid, now, incarnation=None, skip_node=None: \
+        kills.append((jid, incarnation, skip_node))
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                               node_num=3, sim_runtime=1e9), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [jid]
+    dead = sched.running[jid].node_ids[0]
+    sched.on_craned_down(dead, now=5.0)
+    assert kills == [(jid, 0, dead)], kills  # guarded at incarnation 0
+    assert sched.pending[jid].requeue_count == 1
+
+
+def test_stale_guarded_kill_misses_replaced_incarnation_in_sim():
+    meta, sched = make()
+    sim = SimCluster(sched)
+    sched.dispatch = sim.dispatch
+    sched.dispatch_terminate = sim.terminate
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                               sim_runtime=40.0), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [jid]
+    # a stale kill aimed at a NONEXISTENT (pre-requeue) incarnation
+    sim.terminate(jid, now=2.0, incarnation=7)
+    sched.schedule_cycle(now=3.0)
+    assert jid in sched.running            # untouched
+    # the matching incarnation dies
+    sim.terminate(jid, now=4.0, incarnation=0)
+    sched.schedule_cycle(now=5.0)
+    assert sched.job_info(jid).status == JobStatus.CANCELLED
+
+
+def test_stale_whole_job_report_cannot_finalize_new_incarnation():
+    meta, sched = make()
+    sched.dispatch = lambda job, nodes: None
+    sched.dispatch_terminate = lambda jid, now, **kw: None
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                               sim_runtime=1e9, node_num=2), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [jid]
+    sched.on_craned_down(sched.running[jid].node_ids[0], now=2.0)
+    assert sched.pending[jid].requeue_count == 1
+    assert sched.schedule_cycle(now=3.0) == [jid]      # re-placed
+    # stale report stamped with the OLD incarnation arrives late
+    sched.step_status_change(jid, JobStatus.CANCELLED, 130, 4.0,
+                             incarnation=0)
+    sched.schedule_cycle(now=5.0)
+    assert jid in sched.running
+    assert sched.running[jid].status == JobStatus.RUNNING
+
+
+def test_evicted_job_with_pending_cancel_finalizes_cancelled():
+    from cranesched_tpu.ctld.accounting import (
+        Account, AccountManager, AdminLevel, Qos, User)
+    meta = MetaContainer()
+    meta.add_node("n0", meta.layout.encode(cpu=4, mem_bytes=8 << 30,
+                                           is_capacity=True))
+    meta.craned_up(0)
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="hi", priority=100, preempt={"lo"}))
+    mgr.add_qos("root", Qos(name="lo", priority=1))
+    mgr.add_account("root", Account(name="acc", allowed_qos={"hi", "lo"},
+                                    default_qos="lo"))
+    mgr.add_user("root", User(name="u", uid=1), "acc")
+    sched = JobScheduler(meta, SchedulerConfig(preempt_mode="requeue",
+                                               backfill=False),
+                         accounts=mgr)
+    sched.dispatch = lambda job, nodes: None
+    sched.dispatch_terminate = lambda jid, now, **kw: None
+    lo = sched.submit(JobSpec(user="u", account="acc", qos="lo",
+                              res=ResourceSpec(cpu=4.0, mem_bytes=1 << 30),
+                              sim_runtime=1e9), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [lo]
+    sched.cancel(lo, now=2.0)
+    hi = sched.submit(JobSpec(user="u", account="acc", qos="hi",
+                              res=ResourceSpec(cpu=4.0, mem_bytes=1 << 30),
+                              sim_runtime=10.0), now=3.0)
+    assert hi in sched.schedule_cycle(now=4.0)
+    assert sched.job_info(lo).status == JobStatus.CANCELLED
+    assert lo not in sched.pending
+
+
+def test_cancel_renewal_backoff():
+    meta, sched = make()
+    kills = []
+    sched.dispatch = lambda job, nodes: None
+    sched.dispatch_terminate = \
+        lambda jid, now, **kw: kills.append((jid, now))
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                               sim_runtime=1e9), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [jid]
+    sched.cancel(jid, now=2.0)
+    for t in range(3, 9):
+        sched.schedule_cycle(now=float(t))
+    # initial send at t=2 plus exactly one renewal (5 s backoff) at t=7
+    assert [t for _, t in kills] == [2.0, 7.0], kills
+    sched.step_status_change(jid, JobStatus.CANCELLED, 130, 8.5)
+    sched.schedule_cycle(now=20.0)
+    assert len(kills) == 2
+    assert jid not in sched._cancel_kill_sent

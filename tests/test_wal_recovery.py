@@ -116,7 +116,8 @@ def test_cancel_intent_survives_crash(tmp_path):
 
     meta2, sched2, cluster2 = build(tmp_path)
     kills = []
-    sched2.dispatch_terminate = lambda job_id, now: kills.append(job_id)
+    sched2.dispatch_terminate = \
+        lambda job_id, now, **kw: kills.append(job_id)
     sched2.recover(WriteAheadLog.replay(path), now=2.0)
     job = sched2.job_info(jid)
     assert job.cancel_requested
