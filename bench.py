@@ -22,24 +22,68 @@ import numpy as np
 BASELINE_DECISIONS_PER_SEC = 100_000.0
 
 
-def _devices_with_timeout(timeout_s: float):
+def _devices_with_timeout(timeout_s: float) -> dict:
     """TPU acquisition through this environment's tunnel can hang for
-    many minutes; probe it in a subprocess and fall back to CPU so the
-    bench always produces a number."""
+    many minutes; probe it in a subprocess (retrying until the budget is
+    spent) and fall back to CPU so the bench always produces a number.
+
+    Returns a diagnosis dict that lands in the output JSON — a CPU
+    number must never masquerade as a TPU result without saying why
+    (round-2 verdict: record the acquisition failure, don't silently
+    benchmark CPU)."""
     import subprocess
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=timeout_s, capture_output=True, text=True)
-        if probe.returncode == 0 and "ok" in probe.stdout:
-            return  # real backend reachable; this process uses it too
-    except subprocess.TimeoutExpired:
-        pass
+    import time as _time
+
+    attempts = []
+    deadline = _time.monotonic() + timeout_s
+    attempt_s = min(max(timeout_s / 2, 60.0), 300.0)
+    while _time.monotonic() < deadline:
+        budget = min(attempt_s, max(deadline - _time.monotonic(), 10.0))
+        t0 = _time.monotonic()
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-u", "-c",
+                 "import jax; ds = jax.devices(); "
+                 "print('ok', ds[0].platform)"],
+                timeout=budget, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            attempts.append({"outcome": "timeout",
+                             "seconds": round(_time.monotonic() - t0, 1)})
+            continue
+        if probe.returncode == 0 and probe.stdout.startswith("ok"):
+            attempts.append({"outcome": "ok",
+                             "seconds": round(_time.monotonic() - t0, 1)})
+            return {"acquired": True, "attempts": attempts}
+        attempts.append({
+            "outcome": f"rc={probe.returncode}",
+            "seconds": round(_time.monotonic() - t0, 1),
+            "tail": (probe.stderr or probe.stdout).strip()[-300:]})
+        # a fast deterministic failure (broken install, immediate
+        # UNAVAILABLE) must not spin subprocesses for the whole budget:
+        # back off, and give up after a few identical failures.
+        # Timeouts are excluded — a hanging tunnel may come alive late,
+        # so those retry until the budget is spent as documented.
+        recent = [a["outcome"] for a in attempts[-3:]]
+        if (len(recent) == 3 and len(set(recent)) == 1
+                and recent[0] != "timeout"):
+            break
+        _time.sleep(min(10.0, max(deadline - _time.monotonic(), 0)))
     # unreachable: force CPU before jax initializes in THIS process
+    configured = os.environ.get("JAX_PLATFORMS", "auto")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    return {
+        "acquired": False, "attempts": attempts,
+        "diagnosis": (
+            "jax.devices() on the configured platform "
+            f"({configured!r}) never returned within the probe budget — the "
+            "TPU tunnel hangs during backend initialization (reproduced "
+            "independently: a 540 s direct probe also hung after the "
+            "'Platform axon is experimental' warning).  Falling back to "
+            "CPU so the bench still yields a number; the recorded device "
+            "below is therefore NOT a TPU."),
+    }
 
 
 def main() -> int:
@@ -47,11 +91,13 @@ def main() -> int:
     num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
 
+    acquisition = {"acquired": True, "attempts": [],
+                   "note": "JAX_PLATFORMS=cpu was pre-set"}
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
         # probe whenever CPU isn't already forced: auto-detection with an
         # unset JAX_PLATFORMS can hang on the TPU tunnel just as well
-        _devices_with_timeout(
-            float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+        acquisition = _devices_with_timeout(
+            float(os.environ.get("BENCH_DEVICE_TIMEOUT", 600)))
 
     import jax
     import jax.numpy as jnp
@@ -182,6 +228,7 @@ def main() -> int:
                                         for k, v in results.items()},
             "placed": placements_placed,
             "device": str(dev), "repeats": repeats,
+            "device_acquisition": acquisition,
         },
     }))
     return 0

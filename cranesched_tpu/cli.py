@@ -161,14 +161,135 @@ def _build_spec(args):
     return spec
 
 
+def cmd_calloc(args) -> int:
+    """Allocate resources WITHOUT running anything (reference calloc):
+    the allocation sits until `crun --jobid` steps run in it and `cfree`
+    releases it (or the time limit expires)."""
+    import time as _time
+    spec = _build_spec(args)
+    spec.alloc_only = True
+    client = _client(args)
+    reply = client.submit(spec)
+    if not reply.job_id:
+        print(f"calloc: submit failed: {reply.error}", file=sys.stderr)
+        return 1
+    job_id = reply.job_id
+    deadline = _time.time() + args.wait
+    while _time.time() < deadline:
+        jobs = client.query_jobs(job_ids=[job_id]).jobs
+        if jobs and jobs[0].status == "Running":
+            print(f"Granted allocation {job_id} on "
+                  f"{','.join(jobs[0].node_names)}")
+            return 0
+        if jobs and jobs[0].status not in ("Pending", "Running"):
+            print(f"calloc: allocation {job_id} ended "
+                  f"({jobs[0].status})", file=sys.stderr)
+            return 1
+        _time.sleep(args.poll)
+    print(f"calloc: allocation {job_id} still pending after "
+          f"{args.wait:.0f}s (it stays queued; ccancel {job_id} to "
+          "drop it)", file=sys.stderr)
+    return 1
+
+
+def cmd_cfree(args) -> int:
+    """Release a calloc allocation."""
+    client = _client(args)
+    reply = client.free_allocation(args.job_id)
+    if reply.ok:
+        print(f"Allocation {args.job_id} released")
+        return 0
+    print(f"cfree: {reply.error}", file=sys.stderr)
+    return 1
+
+
+def cmd_cstep(args) -> int:
+    """List a job's steps (reference cqueue --steps)."""
+    client = _client(args)
+    reply = client.query_steps(args.job_id)
+    rows = []
+    for s in reply.steps:
+        rows.append((f"{s.job_id}.{s.step_id}", s.name[:20], s.status,
+                     s.exit_code,
+                     ",".join(s.node_names) or "-"))
+    print(_fmt_table(rows, ("STEPID", "NAME", "STATE", "EXIT",
+                            "NODES")))
+    return 0
+
+
+def _run_step_in_alloc(args, client) -> int:
+    """crun --jobid: submit a step into a live allocation and follow it
+    via the step table + its output file."""
+    import tempfile
+    import time as _time
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    cleanup_path = None
+    if not args.output:
+        fd, args.output = tempfile.mkstemp(prefix="crun_step_",
+                                           suffix=".out")
+        os.close(fd)
+        cleanup_path = args.output
+    # -N maps 1:1 onto the step's node span (0 = every allocation node);
+    # the default -N 1 therefore means exactly one node, matching the
+    # standalone crun semantics
+    spec = pb.StepSpec(name=args.job_name, script=args.script,
+                       node_num=args.nodes,
+                       time_limit=args.time, output_path=args.output)
+    if args.cpu or args.mem != "0":
+        spec.res.CopyFrom(pb.ResourceSpec(
+            cpu=args.cpu, mem_bytes=_parse_mem(args.mem)))
+    reply = client.submit_step(args.jobid, spec)
+    if reply.step_id < 0:
+        print(f"crun: step rejected: {reply.error}", file=sys.stderr)
+        return 1
+    step_id = reply.step_id
+    out_path = args.output.replace("%j", str(args.jobid))
+    offset, exit_code = 0, 0
+    try:
+        while True:
+            steps = [s for s in client.query_steps(args.jobid).steps
+                     if s.step_id == step_id]
+            status = steps[0].status if steps else "?"
+            try:
+                with open(out_path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read()
+                if chunk:
+                    sys.stdout.write(chunk.decode(errors="replace"))
+                    sys.stdout.flush()
+                    offset += len(chunk)
+            except OSError:
+                pass
+            if status not in ("Pending", "Running"):
+                exit_code = steps[0].exit_code if steps else 1
+                break
+            _time.sleep(args.poll)
+    except KeyboardInterrupt:
+        client.cancel_step(args.jobid, step_id)
+        print(f"\ncrun: step {args.jobid}.{step_id} cancelled",
+              file=sys.stderr)
+        return 130
+    finally:
+        if cleanup_path is not None:
+            try:
+                os.unlink(cleanup_path)
+            except OSError:
+                pass
+    return exit_code
+
+
 def cmd_crun(args) -> int:
     """Interactive-style run: submit, wait, stream the output file.
 
-    Streams via the shared filesystem (the reference likewise assumes
-    shared storage for job output; its cfored bidi-stream I/O hub is the
+    With ``--jobid`` the command becomes a STEP inside an existing
+    calloc allocation (reference crun within calloc).  Streams via the
+    shared filesystem (the reference likewise assumes shared storage for
+    job output; its cfored bidi-stream I/O hub is the
     network-transparent variant of this seam)."""
     import tempfile
     import time as _time
+    if args.jobid:
+        return _run_step_in_alloc(args, _client(args))
     cleanup_path = None
     if not args.output:
         fd, args.output = tempfile.mkstemp(prefix="crun_",
@@ -410,7 +531,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reservation", default="")
     p.add_argument("--output", "-o", default="")
     p.add_argument("--poll", type=float, default=0.3)
+    p.add_argument("--jobid", type=int, default=0,
+                   help="run as a STEP inside this calloc allocation")
     p.set_defaults(func=cmd_crun)
+
+    p = sub.add_parser("calloc",
+                       help="allocate resources (steps run via "
+                            "crun --jobid; release with cfree)")
+    p.add_argument("--job-name", "-J", default="calloc")
+    p.add_argument("--user", default=os.environ.get("USER", "user"))
+    p.add_argument("--account", "-A", default="default")
+    p.add_argument("--partition", "-p", default="default")
+    p.add_argument("--cpu", "-c", type=float, default=1.0)
+    p.add_argument("--mem", default="0")
+    p.add_argument("--memsw", default="")
+    p.add_argument("--nodes", "-N", type=int, default=1)
+    p.add_argument("--gres", default="")
+    p.add_argument("--time", "-t", type=int, default=3600)
+    p.add_argument("--qos", "-q", default="")
+    p.add_argument("--reservation", default="")
+    p.add_argument("--wait", type=float, default=30.0,
+                   help="seconds to wait for the allocation to start")
+    p.add_argument("--poll", type=float, default=0.3)
+    p.set_defaults(func=cmd_calloc)
+
+    p = sub.add_parser("cfree", help="release a calloc allocation")
+    p.add_argument("job_id", type=int)
+    p.set_defaults(func=cmd_cfree)
+
+    p = sub.add_parser("cstep", help="list a job's steps")
+    p.add_argument("job_id", type=int)
+    p.set_defaults(func=cmd_cstep)
 
     p = sub.add_parser("cqueue", help="show the job queue")
     p.add_argument("--user", "-u", default="")

@@ -44,13 +44,31 @@ class CranedState(enum.Enum):
     READY = "Ready"
 
 
-class _Step:
-    def __init__(self, job_id: int, proc: subprocess.Popen,
-                 incarnation: int = 0, gres_held=None):
+class _Alloc:
+    """One job allocation on this node (reference JobInD — cgroup + GRES
+    held for the job, steps spawned inside it; JobManager.h:53).
+
+    ``implicit`` allocations are created on the fly by a batch
+    ExecuteStep and torn down when their last step exits; explicit ones
+    (AllocJob) live until FreeJob."""
+
+    def __init__(self, job_id: int, incarnation: int, gres_held,
+                 env: dict, procs_path: str, implicit: bool):
         self.job_id = job_id
-        self.proc = proc
         self.incarnation = incarnation
         self.gres_held = gres_held or {}
+        self.env = env
+        self.procs_path = procs_path
+        self.implicit = implicit
+
+
+class _Step:
+    def __init__(self, job_id: int, proc: subprocess.Popen,
+                 incarnation: int = 0, step_id: int = 0):
+        self.job_id = job_id
+        self.step_id = step_id
+        self.proc = proc
+        self.incarnation = incarnation
         self.cancelled = False
 
 
@@ -94,17 +112,25 @@ class CranedDaemon:
         self.node_id: int | None = None
         self.cgroups = CgroupV2(cgroup_root)
         self._ctld = CtldClient(ctld_address, timeout=10.0)
-        self._steps: dict[int, _Step] = {}
+        # allocations (job-level: cgroup + GRES) and the steps running
+        # inside them, keyed (job_id, step_id)
+        self._allocs: dict[int, _Alloc] = {}
+        self._steps: dict[tuple[int, int], _Step] = {}
         # kills that race an in-flight spawn handshake: recorded only
-        # while a spawn for that job is actually in progress (a kill for
-        # a step that already finished is a no-op and must NOT poison a
-        # future re-dispatch of the same job id).  Keyed with the
+        # while a spawn for that (job, step) is actually in progress (a
+        # kill for a step that already finished is a no-op and must NOT
+        # poison a future re-dispatch of the same ids).  Keyed with the
         # spawning incarnation so an incarnation-guarded kill can be
         # matched against the spawn it was aimed at; the latch value is
         # the guarded incarnation, or None for a wildcard (user-cancel)
         # kill.  A wildcard latch subsumes any guarded one.
-        self._spawning: dict[int, int] = {}
-        self._pending_kills: dict[int, int | None] = {}
+        self._spawning: dict[tuple[int, int], int] = {}
+        self._pending_kills: dict[tuple[int, int], int | None] = {}
+        # same race shape at the allocation level: a FreeJob that
+        # arrives while an AllocJob is still in flight must latch so
+        # the late allocation is torn down, not leaked
+        self._allocating: dict[int, int] = {}
+        self._pending_frees: dict[int, int | None] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
@@ -112,7 +138,33 @@ class CranedDaemon:
 
     # ---- the Craned service (ctld -> craned push) ----
 
+    def AllocJob(self, request, context):
+        """Create the allocation only (the AllocJobs half): cgroup +
+        GRES hold, no supervisor until steps arrive."""
+        job_id = request.job_id
+        with self._lock:
+            self._allocating[job_id] = request.incarnation
+        try:
+            self._ensure_alloc(request, implicit=False)
+            return pb.OkReply(ok=True)
+        except Exception as exc:
+            return pb.OkReply(ok=False, error=str(exc))
+        finally:
+            with self._lock:
+                if self._allocating.get(job_id) == request.incarnation:
+                    self._allocating.pop(job_id, None)
+                lat = self._pending_frees.get(job_id, "absent")
+                apply_free = (lat != "absent"
+                              and (lat is None
+                                   or lat == request.incarnation))
+                if apply_free:
+                    self._pending_frees.pop(job_id, None)
+            if apply_free:
+                # a FreeJob raced our in-flight create: honor it now
+                self._free_job(job_id, request.incarnation)
+
     def ExecuteStep(self, request, context):
+        key = (request.job_id, request.step_id)
         try:
             self._spawn_step(request)
             return pb.OkReply(ok=True)
@@ -123,42 +175,92 @@ class CranedDaemon:
                 # only clear OUR spawn record: a slow stale-incarnation
                 # handler must not clobber the record (and latched kill)
                 # of a newer incarnation's in-flight spawn
-                if self._spawning.get(request.job_id) == \
-                        request.incarnation:
-                    self._spawning.pop(request.job_id, None)
+                if self._spawning.get(key) == request.incarnation:
+                    self._spawning.pop(key, None)
                     # drop only a latch aimed at our (now finished) spawn
                     # — wildcard included: the kill was a no-op against a
                     # step that never registered, and a future
                     # re-dispatch must not be poisoned
-                    self._pending_kills.pop(request.job_id, None)
+                    self._pending_kills.pop(key, None)
+            self._maybe_teardown_alloc(request.job_id)
 
     def TerminateStep(self, request, context):
+        """Kill one step (step_id present) or every step of the job."""
         guard = (request.incarnation if request.HasField("incarnation")
                  else None)
+        targets = []
         with self._lock:
-            step = self._steps.get(request.job_id)
-            if step is not None and (guard is None
-                                     or guard == step.incarnation):
-                step.cancelled = True
+            if request.HasField("step_id"):
+                keys = [(request.job_id, request.step_id)]
             else:
+                keys = [k for k in self._steps if k[0] == request.job_id]
+                keys += [k for k in self._spawning
+                         if k[0] == request.job_id and k not in keys]
+            for key in keys:
+                step = self._steps.get(key)
+                if step is not None and (guard is None
+                                         or guard == step.incarnation):
+                    step.cancelled = True
+                    targets.append(step)
+                    continue
                 # no registered step of the targeted incarnation — maybe
                 # the kill raced an in-flight ExecuteStep handshake for
                 # it: latch so it applies the moment the step registers.
                 # (Checked even when a DIFFERENT incarnation's step is
                 # registered: a stale step can coexist with the new
                 # incarnation's spawn on the same node.)
-                spawn_inc = self._spawning.get(request.job_id)
+                spawn_inc = self._spawning.get(key)
                 if spawn_inc is not None and (guard is None
                                               or guard == spawn_inc):
                     # a wildcard latch (None) subsumes any guarded one
-                    if self._pending_kills.get(request.job_id,
-                                               "absent") is not None:
-                        self._pending_kills[request.job_id] = guard
+                    if self._pending_kills.get(key, "absent") is not None:
+                        self._pending_kills[key] = guard
                 # else: the step already finished (or never started) —
                 # the kill is a no-op
-                return pb.OkReply(ok=True)
-        self._send_verb(step, "TERM")
+        for step in targets:
+            self._send_verb(step, "TERM")
         return pb.OkReply(ok=True)
+
+    def FreeJob(self, request, context):
+        """Release the allocation: kill remaining steps, then drop the
+        cgroup and GRES (the FreeJobs half)."""
+        guard = (request.incarnation if request.HasField("incarnation")
+                 else None)
+        self._free_job(request.job_id, guard)
+        return pb.OkReply(ok=True)
+
+    def _free_job(self, job_id: int, guard: int | None) -> None:
+        with self._lock:
+            alloc = self._allocs.get(job_id)
+            if alloc is None:
+                # maybe the AllocJob is still in flight: latch the free
+                # so the late allocation is torn down on arrival
+                alloc_inc = self._allocating.get(job_id)
+                if alloc_inc is not None and (guard is None
+                                              or guard == alloc_inc):
+                    if self._pending_frees.get(job_id,
+                                               "absent") is not None:
+                        self._pending_frees[job_id] = guard
+                return
+            if guard is not None and guard != alloc.incarnation:
+                return
+            alloc.implicit = True  # teardown once the last step exits
+            steps = [s for (j, _), s in self._steps.items()
+                     if j == job_id]
+            # steps whose ExecuteStep spawn is still in flight must die
+            # too: latch the kill exactly like TerminateStep does, else
+            # a step spawned concurrently with the free survives on
+            # resources ctld already returned to the ledger
+            for key, spawn_inc in self._spawning.items():
+                if key[0] != job_id:
+                    continue
+                if guard is None or guard == spawn_inc:
+                    if self._pending_kills.get(key, "absent") is not None:
+                        self._pending_kills[key] = guard
+        for step in steps:
+            step.cancelled = True
+            self._send_verb(step, "TERM")
+        self._maybe_teardown_alloc(job_id)
 
     def SuspendStep(self, request, context):
         return self._freeze(request.job_id, True)
@@ -168,18 +270,20 @@ class CranedDaemon:
 
     def _freeze(self, job_id: int, frozen: bool):
         with self._lock:
-            step = self._steps.get(job_id)
-        if step is None:
+            steps = [s for (j, _), s in self._steps.items() if j == job_id]
+        if not steps:
             return pb.OkReply(ok=False, error="no such step")
-        # the supervisor ALWAYS gets the verb: it pauses the time-limit
+        # the supervisors ALWAYS get the verb: it pauses the time-limit
         # clock (and SIGSTOPs the group, harmless if also frozen); the
         # cgroup freezer additionally freezes when available
         if frozen:
-            self._send_verb(step, "STOP")
+            for step in steps:
+                self._send_verb(step, "STOP")
             self.cgroups.freeze(job_id, True)
         else:
             self.cgroups.freeze(job_id, False)
-            self._send_verb(step, "CONT")
+            for step in steps:
+                self._send_verb(step, "CONT")
         return pb.OkReply(ok=True)
 
     def _send_verb(self, step: _Step, verb: str) -> None:
@@ -191,15 +295,26 @@ class CranedDaemon:
 
     # ---- step spawning (StepInstance::SpawnSupervisor analog) ----
 
-    def _spawn_step(self, request) -> None:
+    def _ensure_alloc(self, request, implicit: bool) -> "_Alloc":
+        """Create (or reuse) the job's allocation: GRES hold + cgroup.
+        Idempotent per incarnation; a stale-incarnation allocation is NOT
+        reused (the caller's request fails and the dispatcher retries)."""
         job_id = request.job_id
         spec = request.spec
         with self._lock:
-            self._spawning[job_id] = request.incarnation
+            alloc = self._allocs.get(job_id)
+            if alloc is not None:
+                if alloc.incarnation == request.incarnation:
+                    if not implicit:
+                        alloc.implicit = False
+                    return alloc
+                raise RuntimeError(
+                    "retryable: allocation of a previous incarnation "
+                    "still tearing down")
         # GRES first: nothing else to clean up if the pool can't satisfy
-        step_env = {"CRANE_JOB_NAME": spec.name,
-                    "CRANE_JOB_NODELIST": self.name}
-        gres_held = self._assign_gres(spec, step_env)
+        env = {"CRANE_JOB_NAME": spec.name,
+               "CRANE_JOB_NODELIST": self.name}
+        gres_held = self._assign_gres(spec, env)
         if gres_held is None:
             # a re-dispatch can overlap the previous incarnation's
             # teardown by a few seconds — the dispatcher retries these
@@ -207,6 +322,60 @@ class CranedDaemon:
         procs_path = self.cgroups.create(
             job_id, cpu=spec.res.cpu, mem_bytes=spec.res.mem_bytes,
             memsw_bytes=spec.res.memsw_bytes)
+        alloc = _Alloc(job_id, request.incarnation, gres_held, env,
+                       procs_path, implicit)
+        with self._lock:
+            raced = self._allocs.get(job_id)
+            if raced is not None and raced.incarnation == \
+                    request.incarnation:
+                # two concurrent creates for the same incarnation: keep
+                # the first, roll ours back
+                winner = raced
+            else:
+                self._allocs[job_id] = alloc
+                winner = alloc
+        if winner is not alloc:
+            self._release_gres(gres_held)
+            return winner
+        return alloc
+
+    def _maybe_teardown_alloc(self, job_id: int) -> None:
+        """Tear down an implicit allocation once nothing lives in it."""
+        with self._lock:
+            alloc = self._allocs.get(job_id)
+            if alloc is None or not alloc.implicit:
+                return
+            busy = (any(j == job_id for (j, _) in self._steps)
+                    or any(j == job_id for (j, _) in self._spawning))
+            if busy:
+                return
+            self._allocs.pop(job_id, None)
+        self._release_gres(alloc.gres_held)
+        self.cgroups.destroy(job_id)
+
+    def _spawn_step(self, request) -> None:
+        job_id = request.job_id
+        step_id = request.step_id
+        key = (job_id, step_id)
+        spec = request.spec
+        with self._lock:
+            self._spawning[key] = request.incarnation
+        # a batch ExecuteStep with no prior AllocJob creates the
+        # allocation implicitly (torn down with its last step)
+        alloc = self._ensure_alloc(request, implicit=True)
+        step_spec = (request.step if request.HasField("step") else None)
+        script = (step_spec.script if step_spec and step_spec.script
+                  else spec.script)
+        output_path = (step_spec.output_path
+                       if step_spec and step_spec.output_path
+                       else spec.output_path)
+        time_limit = (step_spec.time_limit
+                      if step_spec and step_spec.time_limit
+                      else spec.time_limit)
+        step_env = dict(alloc.env)
+        step_env["CRANE_STEP_ID"] = str(step_id)
+        if step_spec and step_spec.name:
+            step_env["CRANE_STEP_NAME"] = step_spec.name
         # the supervisor must import this package regardless of workdir
         import cranesched_tpu
         import os
@@ -221,11 +390,11 @@ class CranedDaemon:
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             cwd=self.workdir, env=env)
         init = dict(
-            job_id=job_id, script=spec.script,
-            output_path=spec.output_path,
-            time_limit=spec.time_limit,
+            job_id=job_id, script=script,
+            output_path=output_path,
+            time_limit=time_limit,
             env=step_env,
-            cgroup_procs=procs_path)
+            cgroup_procs=alloc.procs_path)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()
@@ -237,38 +406,38 @@ class CranedDaemon:
             proc.stdin.flush()
         except Exception:
             # every spawn failure must leak nothing: kill AND REAP the
-            # process (the cgroup rmdir races a dying member otherwise),
-            # free the slots, drop the cgroup
+            # process (a cgroup rmdir in the implicit-alloc teardown
+            # races a dying member otherwise).  The alloc's GRES/cgroup
+            # are rolled back by _maybe_teardown_alloc (implicit) or
+            # kept for the allocation (explicit).
             proc.kill()
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
-            self._release_gres(gres_held)
-            self.cgroups.destroy(job_id)
             raise
         step = _Step(job_id, proc, incarnation=request.incarnation,
-                     gres_held=gres_held)
+                     step_id=step_id)
         with self._lock:
-            existing = self._steps.get(job_id)
+            existing = self._steps.get(key)
             # a slow stale spawn must not clobber an already-registered
             # NEWER incarnation (incarnations only grow); conversely,
             # registering over an older stale step evicts it
             stale_self = (existing is not None
                           and existing.incarnation > request.incarnation)
             if not stale_self:
-                self._steps[job_id] = step
-            if self._spawning.get(job_id) == request.incarnation:
-                self._spawning.pop(job_id, None)
+                self._steps[key] = step
+            if self._spawning.get(key) == request.incarnation:
+                self._spawning.pop(key, None)
             # consume a latched kill only if it was aimed at US (guarded
             # with our incarnation) or at whatever runs (wildcard None) —
             # a kill latched for a different concurrent spawn stays
-            lat = self._pending_kills.get(job_id, "absent")
+            lat = self._pending_kills.get(key, "absent")
             killed_already = (not stale_self and lat != "absent"
                               and (lat is None
                                    or lat == request.incarnation))
             if killed_already:
-                self._pending_kills.pop(job_id, None)
+                self._pending_kills.pop(key, None)
         if stale_self:
             # ctld has moved past this incarnation: kill our own spawn
             step.cancelled = True
@@ -325,17 +494,18 @@ class CranedDaemon:
         """SIGCHLD/reporting path (supervisor exit -> StepStatusChange)."""
         report = step.proc.stdout.readline().strip().decode()
         step.proc.wait()
-        # the step's own slots are always returned (they belong to this
-        # incarnation, held on the step object)
-        self._release_gres(step.gres_held)
+        key = (step.job_id, step.step_id)
         with self._lock:
             # only clean up if the registry still points at OUR step — a
             # re-dispatched incarnation may have replaced the entry
-            mine = self._steps.get(step.job_id) is step
+            mine = self._steps.get(key) is step
             if mine:
-                self._steps.pop(step.job_id, None)
+                self._steps.pop(key, None)
         if mine:
-            self.cgroups.destroy(step.job_id)
+            # implicit allocations die with their last step; explicit
+            # ones wait for FreeJob (their GRES/cgroup belong to the
+            # allocation, not the step)
+            self._maybe_teardown_alloc(step.job_id)
         if step.cancelled or report == "KILLED":
             status, code = "Cancelled", 130
         elif report == "TIMEOUT":
@@ -351,7 +521,8 @@ class CranedDaemon:
                                           node_id=self.node_id
                                           if self.node_id is not None
                                           else -1,
-                                          incarnation=step.incarnation)
+                                          incarnation=step.incarnation,
+                                          step_id=step.step_id)
         except (grpc.RpcError, ValueError):
             pass  # ctld down / client closed: the ping timeout + WAL
                   # reconcile at re-registration
@@ -359,8 +530,10 @@ class CranedDaemon:
     # ---- lifecycle: serve + register + ping ----
 
     _RPCS = {
+        "AllocJob": (pb.ExecuteStepRequest, pb.OkReply),
         "ExecuteStep": (pb.ExecuteStepRequest, pb.OkReply),
         "TerminateStep": (pb.JobIdRequest, pb.OkReply),
+        "FreeJob": (pb.JobIdRequest, pb.OkReply),
         "SuspendStep": (pb.JobIdRequest, pb.OkReply),
         "ResumeStep": (pb.JobIdRequest, pb.OkReply),
     }
@@ -432,11 +605,18 @@ class CranedDaemon:
             # be running; anything else died with our old registration)
             expected = set(reply.expected_jobs)
             with self._lock:
-                stale = [s for j, s in self._steps.items()
+                stale = [s for (j, _), s in self._steps.items()
                          if j not in expected]
+                stale_allocs = [j for j in self._allocs
+                                if j not in expected]
+                for j in stale_allocs:
+                    # mark for teardown once the stale steps die
+                    self._allocs[j].implicit = True
             for step in stale:
                 step.cancelled = True
                 self._send_verb(step, "TERM")
+            for j in stale_allocs:
+                self._maybe_teardown_alloc(j)
             return True
         return False
 

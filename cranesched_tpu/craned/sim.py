@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 
-from cranesched_tpu.ctld.defs import Job, JobStatus
+from cranesched_tpu.ctld.defs import Job, JobStatus, StepStatus
 from cranesched_tpu.ctld.scheduler import JobScheduler
 
 
@@ -31,6 +31,8 @@ class _Completion:
     # incarnation token: a stale event from a dispatch that predates a
     # requeue must not complete the job's NEW run
     requeue_count: int = dataclasses.field(compare=False, default=0)
+    # step-level completion (None = whole-job / implicit batch step)
+    step_id: int | None = dataclasses.field(compare=False, default=None)
 
 
 class SimCraned:
@@ -69,12 +71,25 @@ class SimCluster:
 
     # -- ctld-facing stubs (the dispatch seam) --
 
+    def wire(self, scheduler) -> None:
+        """Attach every dispatch seam in one place.  dispatch_free_alloc
+        keeps the scheduler default (delegates to terminate — the sim
+        has no allocation state to free)."""
+        scheduler.dispatch = self.dispatch
+        scheduler.dispatch_step = self.dispatch_step
+        scheduler.dispatch_terminate = self.terminate
+        scheduler.dispatch_terminate_step = self.terminate_step
+        scheduler.dispatch_suspend = self.suspend
+        scheduler.dispatch_resume = self.resume
+
     def dispatch(self, job: Job, node_ids: list[int]) -> None:
         """AllocJobs/AllocSteps fan-out analog (JobScheduler.cpp:1732-1839):
         register the step on every allocated node and schedule its
         completion."""
         for node_id in node_ids:
             self.craneds[node_id].alloc_step(job.job_id)
+        if job.spec.alloc_only:
+            return  # the allocation just sits; steps arrive separately
         runtime = (job.spec.sim_runtime if job.spec.sim_runtime is not None
                    else self.default_runtime)
         start = job.start_time if job.start_time is not None else self.now
@@ -88,6 +103,36 @@ class SimCluster:
             heapq.heappush(self._events, _Completion(
                 start + runtime, job.job_id, status,
                 job.spec.sim_exit_code, job.requeue_count))
+
+    def dispatch_step(self, job: Job, step) -> None:
+        """ExecuteStep-per-step analog: schedule the step's completion
+        (its script is virtual; sim_runtime drives the clock)."""
+        runtime = (step.spec.sim_runtime
+                   if step.spec.sim_runtime is not None
+                   else self.default_runtime)
+        start = step.start_time if step.start_time is not None else self.now
+        status = (JobStatus.COMPLETED if step.spec.sim_exit_code == 0
+                  else JobStatus.FAILED)
+        heapq.heappush(self._events, _Completion(
+            start + runtime, job.job_id, status, step.spec.sim_exit_code,
+            job.requeue_count, step_id=step.step_id))
+
+    def terminate_step(self, job_id: int, step_id: int,
+                       now: float | None = None) -> None:
+        """Kill exactly one step: drop its completion event and deliver
+        a Cancelled step report."""
+        job = self.scheduler.running.get(job_id)
+        if job is None:
+            return
+        when = self.now if now is None else max(now, self.now)
+        for i, ev in enumerate(self._events):
+            if ev.job_id == job_id and ev.step_id == step_id:
+                self._events.pop(i)
+                heapq.heapify(self._events)
+                break
+        self.scheduler.step_report(job_id, step_id, StepStatus.CANCELLED,
+                                   130, when,
+                                   incarnation=job.requeue_count)
 
     def suspend(self, job_id: int, now: float) -> None:
         """Freezer analog: pull the completion event, remember remaining
@@ -148,6 +193,13 @@ class SimCluster:
             # skip steps already killed (terminate/cancel raced the finish)
             # and stale events from a pre-requeue incarnation
             if job is None or job.requeue_count != ev.requeue_count:
+                continue
+            if ev.step_id is not None:
+                # per-step completion within a live allocation
+                self.scheduler.step_report(
+                    ev.job_id, ev.step_id, StepStatus(ev.status.value),
+                    ev.exit_code, ev.time, incarnation=ev.requeue_count)
+                sent += 1
                 continue
             self._remove_step_everywhere(ev.job_id)
             self.scheduler.step_status_change(ev.job_id, ev.status,

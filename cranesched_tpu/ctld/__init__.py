@@ -16,6 +16,9 @@ from cranesched_tpu.ctld.defs import (
     JobStatus,
     PendingReason,
     ResourceSpec,
+    Step,
+    StepSpec,
+    StepStatus,
 )
 from cranesched_tpu.ctld.meta import MetaContainer, NodeMeta, Partition
 from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
@@ -30,4 +33,7 @@ __all__ = [
     "PendingReason",
     "ResourceSpec",
     "SchedulerConfig",
+    "Step",
+    "StepSpec",
+    "StepStatus",
 ]

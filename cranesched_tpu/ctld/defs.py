@@ -116,6 +116,65 @@ class ResourceSpec:
                              memsw_bytes=self.memsw_bytes, gres=self.gres)
 
 
+class StepStatus(enum.Enum):
+    """Step lifecycle (reference StepInCtld status machines,
+    CtldPublicDefs.h:521-782): PENDING = accepted, waiting for room in
+    the allocation; RUNNING = supervisors spawned; terminal mirrors the
+    job status space."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    COMPLETED = "Completed"
+    FAILED = "Failed"
+    EXCEED_TIME_LIMIT = "ExceedTimeLimit"
+    CANCELLED = "Cancelled"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self not in (StepStatus.PENDING, StepStatus.RUNNING)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSpec:
+    """One unit of execution inside a job allocation (reference
+    StepInCtld / crun within calloc, CtldPublicDefs.h:521;
+    AllocSteps dispatch JobScheduler.cpp:1793-1839).
+
+    ``res`` is the per-node share of the ALLOCATION the step occupies
+    while running; None = the whole allocation (steps then serialize).
+    ``node_num`` = how many of the job's nodes the step spans (0 = all).
+    ``time_limit`` 0 inherits the job's remaining time."""
+
+    name: str = "step"
+    script: str = ""
+    res: ResourceSpec | None = None
+    node_num: int = 0
+    time_limit: int = 0
+    output_path: str = ""
+    # simulation-only (real planes learn these from the supervisor)
+    sim_runtime: float | None = None
+    sim_exit_code: int = 0
+
+
+@dataclasses.dataclass
+class Step:
+    """Runtime record of one step (reference CommonStepInCtld /
+    DaemonStepInCtld, CtldPublicDefs.h:713-782)."""
+
+    step_id: int
+    spec: StepSpec
+    submit_time: float
+    status: StepStatus = StepStatus.PENDING
+    start_time: float | None = None
+    end_time: float | None = None
+    exit_code: int | None = None
+    node_ids: list[int] = dataclasses.field(default_factory=list)
+    # per-node terminal reports, same aggregation rule as the job's
+    node_reports: dict[int, tuple] = dataclasses.field(
+        default_factory=dict)
+    cancel_requested: bool = False
+
+
 @dataclasses.dataclass(frozen=True)
 class JobSpec:
     """What a user submits (reference JobToCtld / cbatch flags)."""
@@ -160,6 +219,11 @@ class JobSpec:
     # pattern (%j substitutes the job id; reference batch meta)
     script: str = ""
     output_path: str = ""
+    # calloc-style allocation: hold resources WITHOUT an implicit batch
+    # step; steps are submitted separately (SubmitStep) and the job ends
+    # on FreeAllocation / cancel / time limit (reference InteractiveMeta
+    # + calloc semantics, CtldPublicDefs.h:282)
+    alloc_only: bool = False
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None
@@ -209,6 +273,12 @@ class Job:
     # limit (reference JobScheduler.cpp:118-126)
     suspend_time: float | None = None
     suspended_total: float = 0.0
+    # steps inside the allocation (reference job->steps;
+    # batch jobs get an implicit step 0 at start, alloc_only jobs start
+    # empty and accept SubmitStep).  next_step_id survives requeue resets
+    # per the reference's step-id-counter-reset-on-requeue rule.
+    steps: dict[int, "Step"] = dataclasses.field(default_factory=dict)
+    next_step_id: int = 0
     # cached per-node allocation vectors for the current incarnation
     # (derived state — not persisted; cleared on requeue)
     alloc_cache: list | None = dataclasses.field(
@@ -233,3 +303,8 @@ class Job:
         self.alloc_cache = None
         self.requeue_count += 1
         self.priority = 0.0
+        # step-id counters reset on requeue (reference
+        # PersistAndRequeueJobs_/ResetForRequeue, JobScheduler.cpp:
+        # 6950-6965: "step-id counters reset")
+        self.steps = {}
+        self.next_step_id = 0

@@ -35,6 +35,9 @@ from cranesched_tpu.ctld.defs import (
     JobSpec,
     JobStatus,
     PendingReason,
+    Step,
+    StepSpec,
+    StepStatus,
 )
 from cranesched_tpu.ctld.accounting import AccountMetaContainer
 from cranesched_tpu.ctld.licenses import LicenseManager
@@ -161,6 +164,13 @@ class JobScheduler:
         self.running: dict[int, Job] = {}
         self.history: dict[int, Job] = {}    # terminal jobs
         self._status_queue: collections.deque[StatusChange] = (
+            collections.deque())
+        # step-level reports arriving from transport pool threads: deque
+        # appends are thread-safe; the mutations happen when the cycle
+        # (or an RPC holding the server lock) drains them.  Transport
+        # code must NEVER call step_report directly — it mutates
+        # job.steps / the WAL / _try_start_steps without the lock.
+        self._step_report_queue: collections.deque[tuple] = (
             collections.deque())
         self._next_job_id = 1
         self._account_index: dict[str, int] = {}
@@ -384,13 +394,24 @@ class JobScheduler:
             self._finalize_terminal(job)
             return True
         if job_id in self.running:
+            job = self.running[job_id]
+            job.cancel_requested = True
+            if job.spec.alloc_only:
+                # no batch step will ever report: ctld owns the
+                # allocation's lifecycle, so finalize synchronously and
+                # free the allocation on the craneds (best-effort;
+                # re-registration reconciles a missed FreeJob)
+                self._teardown_alloc_job(job, now, JobStatus.CANCELLED,
+                                         130)
+                return True
             # real system: TerminateSteps RPC → craned kills → status
             # change flows back.  The dispatch seam owns the kill; the
             # status change arrives via step_status_change.  The intent is
             # recorded on the job AND WAL-logged so neither a node death
             # racing the kill nor a ctld crash can resurrect the job.
-            job = self.running[job_id]
-            job.cancel_requested = True
+            for step in job.steps.values():
+                if not step.status.is_terminal:
+                    step.cancel_requested = True
             if self.wal is not None:
                 self.wal.job_updated(job)
             self._cancel_kill_sent[job_id] = now
@@ -491,8 +512,22 @@ class JobScheduler:
             StatusChange(job_id, status, exit_code, now,
                          incarnation=queue_incarnation))
 
+    def step_report_async(self, job_id: int, step_id: int,
+                          status: "StepStatus", exit_code: int,
+                          now: float,
+                          incarnation: int | None = None) -> None:
+        """Thread-safe step report enqueue for transport pool threads
+        (drained at the next process_status_changes)."""
+        self._step_report_queue.append(
+            (job_id, step_id, status, exit_code, now, incarnation))
+
     def process_status_changes(self) -> int:
         """Drain the queue (cycle step 1).  Returns #processed."""
+        while self._step_report_queue:
+            args = self._step_report_queue.popleft()
+            job_id, step_id, status, exit_code, now, incarnation = args
+            self.step_report(job_id, step_id, status, exit_code, now,
+                             incarnation=incarnation)
         n = 0
         while self._status_queue:
             ch = self._status_queue.popleft()
@@ -592,6 +627,20 @@ class JobScheduler:
         terminal state outside process_status_changes must use this (a
         bare _finalize drops the event hooks and dependents would wait
         forever — dependency edges are event-driven, never polled)."""
+        # close the step records with the allocation: the implicit batch
+        # step 0 mirrors the job's outcome; any other live step died
+        # with the allocation
+        for step in job.steps.values():
+            if step.status.is_terminal:
+                continue
+            if step.step_id == 0 and not job.spec.alloc_only:
+                step.status = StepStatus(job.status.value)
+                step.exit_code = (job.exit_code
+                                  if job.exit_code is not None else 0)
+            else:
+                step.status = StepStatus.CANCELLED
+                step.exit_code = 130
+            step.end_time = job.end_time
         self._finalize(job)
         self._trigger_dep_event(job)
         if job.array_parent_id is not None:
@@ -643,6 +692,258 @@ class JobScheduler:
     def dispatch_resume(self, job_id: int, now: float) -> None:
         """Transport seam: thaw the job's cgroups."""
 
+    # ------------------------------------------------------------------
+    # steps within a job allocation (reference StepInCtld +
+    # StepScheduleThread_, CtldPublicDefs.h:521-782, JobScheduler.cpp:
+    # 1985; AllocJobs = the allocation, AllocSteps/ExecuteStep = per-step
+    # dispatch :1732-1839).  Batch jobs carry an implicit step 0; a
+    # calloc-style ``alloc_only`` job holds the allocation while crun
+    # steps are submitted, scheduled against the allocation's internal
+    # capacity, and complete independently.
+    # ------------------------------------------------------------------
+
+    def _init_steps(self, job: Job, now: float) -> None:
+        """Called when the allocation starts: batch jobs materialize
+        their implicit step 0 (the batch script); alloc_only jobs start
+        empty."""
+        job.steps = {}
+        if job.spec.alloc_only:
+            job.next_step_id = 0
+            return
+        spec = job.spec
+        job.steps[0] = Step(
+            step_id=0,
+            spec=StepSpec(name="batch", script=spec.script,
+                          res=None, node_num=0,
+                          time_limit=spec.time_limit,
+                          output_path=spec.output_path,
+                          sim_runtime=spec.sim_runtime,
+                          sim_exit_code=spec.sim_exit_code),
+            submit_time=now, status=StepStatus.RUNNING,
+            start_time=now, node_ids=list(job.node_ids))
+        job.next_step_id = 1
+
+    def submit_step(self, job_id: int, spec: StepSpec,
+                    now: float) -> int:
+        """Add a step to a running allocation; returns step_id (-1 =
+        rejected).  The step starts immediately if its per-node share
+        fits in the allocation's remaining internal capacity, else waits
+        PENDING until an earlier step finishes (the reference's step
+        scheduling over the allocation)."""
+        job = self.running.get(job_id)
+        if job is None or job.status != JobStatus.RUNNING:
+            return -1
+        if job.cancel_requested:
+            return -1
+        if spec.node_num > len(job.node_ids):
+            return -1
+        if spec.res is not None:
+            req = spec.res.encode(self.meta.layout)
+            # must fit the allocation's per-node share at all (ignoring
+            # other steps) or it can never start
+            if not all((req <= alloc).all()
+                       for alloc in self._job_alloc(job)):
+                return -1
+        step_id = job.next_step_id
+        job.next_step_id += 1
+        job.steps[step_id] = Step(step_id=step_id, spec=spec,
+                                  submit_time=now)
+        self._try_start_steps(job, now)
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        return step_id
+
+    def _step_req(self, job: Job, step: Step) -> np.ndarray | None:
+        """Per-node vector the step occupies, or None = whole allocation."""
+        if step.spec.res is None:
+            return None
+        return step.spec.res.encode(self.meta.layout)
+
+    def _try_start_steps(self, job: Job, now: float) -> list[int]:
+        """Start pending steps (id order) that fit the allocation's free
+        internal capacity.  A step with res=None takes whole nodes, so
+        such steps serialize; sized steps pack."""
+        started = []
+        allocs = self._job_alloc(job)
+        # free capacity per allocation node = alloc - sum(running steps)
+        free = [a.astype(np.int64).copy() for a in allocs]
+        whole_busy = [False] * len(job.node_ids)
+        for st in job.steps.values():
+            if st.status != StepStatus.RUNNING:
+                continue
+            req = self._step_req(job, st)
+            for n in st.node_ids:
+                i = job.node_ids.index(n)
+                if req is None:
+                    whole_busy[i] = True
+                else:
+                    free[i] -= req
+        for step_id in sorted(job.steps):
+            step = job.steps[step_id]
+            if step.status != StepStatus.PENDING:
+                continue
+            want = step.spec.node_num or len(job.node_ids)
+            req = self._step_req(job, step)
+            picked = []
+            for i, n in enumerate(job.node_ids):
+                if len(picked) == want:
+                    break
+                if whole_busy[i]:
+                    continue
+                if req is None:
+                    if (free[i] == allocs[i]).all():
+                        picked.append(i)
+                elif (req <= free[i]).all():
+                    picked.append(i)
+            if len(picked) < want:
+                continue
+            step.status = StepStatus.RUNNING
+            step.start_time = now
+            step.node_ids = [job.node_ids[i] for i in picked]
+            for i in picked:
+                if req is None:
+                    whole_busy[i] = True
+                else:
+                    free[i] -= req
+            started.append(step_id)
+            self.dispatch_step(job, step)
+        return started
+
+    def dispatch_step(self, job: Job, step: Step) -> None:
+        """Transport seam: push the step to the allocation's craneds."""
+
+    def dispatch_terminate_step(self, job_id: int, step_id: int,
+                                now: float) -> None:
+        """Transport seam: kill exactly one step."""
+
+    def dispatch_free_alloc(self, job_id: int, now: float,
+                            incarnation: int | None = None,
+                            skip_node: int | None = None) -> None:
+        """Transport seam: release the job's ALLOCATION on its craneds
+        (kill remaining steps, drop cgroup + GRES).  Defaults to a plain
+        terminate — the sim plane has no allocation state to free."""
+        self.dispatch_terminate(job_id, now, incarnation=incarnation,
+                                skip_node=skip_node)
+
+    def cancel_step(self, job_id: int, step_id: int, now: float) -> bool:
+        job = self.running.get(job_id)
+        if job is None:
+            return False
+        step = job.steps.get(step_id)
+        if step is None or step.status.is_terminal:
+            return False
+        step.cancel_requested = True
+        if step.status == StepStatus.PENDING:
+            step.status = StepStatus.CANCELLED
+            step.end_time = now
+            step.exit_code = 130
+            if self.wal is not None:
+                self.wal.job_updated(job)
+            return True
+        self.dispatch_terminate_step(job_id, step_id, now)
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        return True
+
+    def _teardown_alloc_job(self, job: Job, now: float,
+                            status: JobStatus, exit_code: int) -> None:
+        """Shared end-of-allocation path (cancel / cfree / time limit):
+        free the allocation on the craneds, return the resources, and
+        finalize with the given outcome.  Live steps are closed
+        uniformly by _finalize_terminal (CANCELLED, 130) — callers must
+        NOT pre-mark them, or the shared closer skips them and the
+        exit code diverges between the paths."""
+        self.dispatch_free_alloc(job.job_id, now,
+                                 incarnation=job.requeue_count)
+        self._release_job_resources(job)
+        del self.running[job.job_id]
+        self._cancel_kill_sent.pop(job.job_id, None)
+        job.status = status
+        job.end_time = now
+        job.exit_code = exit_code
+        self._finalize_terminal(job)
+
+    def free_allocation(self, job_id: int, now: float) -> bool:
+        """End an alloc_only job: kill running steps, release resources,
+        finalize COMPLETED (the calloc exit path)."""
+        job = self.running.get(job_id)
+        if job is None or not job.spec.alloc_only:
+            return False
+        self._teardown_alloc_job(job, now, JobStatus.COMPLETED, 0)
+        return True
+
+    def step_report(self, job_id: int, step_id: int, status: StepStatus,
+                    exit_code: int, now: float, node_id: int = -1,
+                    incarnation: int | None = None) -> None:
+        """Per-step status report from a craned (or whole-step from the
+        sim).  Steps aggregate per-node exactly like jobs; a terminal
+        step frees its internal share and pulls the next pending step
+        in.  Step 0 of a batch job closes the whole job (via the
+        job-level status-change queue, preserving requeue semantics)."""
+        job = self.running.get(job_id)
+        if job is None:
+            return
+        if incarnation is not None and incarnation != job.requeue_count:
+            return
+        step = job.steps.get(step_id)
+        if step is None or step.status.is_terminal:
+            return
+        if node_id >= 0:
+            if node_id not in step.node_ids:
+                return
+            is_failure = status not in (StepStatus.COMPLETED,
+                                        StepStatus.CANCELLED)
+            had_failure = any(
+                st not in (StepStatus.COMPLETED, StepStatus.CANCELLED)
+                for st, _ in step.node_reports.values())
+            step.node_reports[node_id] = (status, exit_code)
+            if is_failure and not had_failure:
+                self.dispatch_terminate_step(job_id, step_id, now)
+            if not all(n in step.node_reports for n in step.node_ids):
+                return
+            status, exit_code = self._aggregate_step(step)
+        step.status = status
+        step.end_time = now
+        step.exit_code = exit_code
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        if step_id == 0 and not job.spec.alloc_only:
+            # the batch step IS the job: feed the job-level machine
+            self._status_queue.append(StatusChange(
+                job_id, JobStatus(status.value), exit_code, now,
+                incarnation=job.requeue_count))
+            return
+        self._try_start_steps(job, now)
+
+    @staticmethod
+    def _aggregate_step(step: Step) -> tuple[StepStatus, int]:
+        """Worst-status-wins aggregation over the step's node reports
+        (same rule as the job-level path)."""
+        agg_status, agg_code = StepStatus.COMPLETED, 0
+        for st, code in step.node_reports.values():
+            if st not in (StepStatus.COMPLETED, StepStatus.CANCELLED):
+                return st, code
+        reports = list(step.node_reports.values())
+        if any(st == StepStatus.CANCELLED for st, _ in reports):
+            if (all(st == StepStatus.CANCELLED for st, _ in reports)
+                    or step.cancel_requested):
+                return StepStatus.CANCELLED, 130
+        return agg_status, agg_code
+
+    def _check_alloc_timeouts(self, now: float) -> None:
+        """alloc_only jobs have no batch supervisor enforcing the time
+        limit — the ctld cycle enforces it (reference: ctld-side
+        termination timers for allocations)."""
+        for job_id, job in list(self.running.items()):
+            if not job.spec.alloc_only:
+                continue
+            if job.status != JobStatus.RUNNING:
+                continue
+            if now >= self._effective_end(job, now):
+                self._teardown_alloc_job(job, now,
+                                         JobStatus.EXCEED_TIME_LIMIT,
+                                         124)
+
     def _effective_end(self, job: Job, now: float) -> float:
         """Expected end with suspended time credited back."""
         start = job.start_time if job.start_time is not None else now
@@ -674,9 +975,17 @@ class JobScheduler:
             # an async kill racing the re-dispatch must miss the new run)
             # and skipping the dead node (RPCs to it only burn a worker).
             if len(job.node_ids) > 1:
-                self.dispatch_terminate(job_id, now,
-                                        incarnation=job.requeue_count,
-                                        skip_node=node_id)
+                if job.spec.alloc_only:
+                    # surviving nodes must also drop the explicit
+                    # allocation (cgroup + GRES), not just kill steps —
+                    # a lingering alloc would refuse the re-dispatch
+                    self.dispatch_free_alloc(
+                        job_id, now, incarnation=job.requeue_count,
+                        skip_node=node_id)
+                else:
+                    self.dispatch_terminate(
+                        job_id, now, incarnation=job.requeue_count,
+                        skip_node=node_id)
             self._release_job_resources(job)
             del self.running[job_id]
             self._cancel_kill_sent.pop(job_id, None)
@@ -740,6 +1049,7 @@ class JobScheduler:
         t0 = _time.perf_counter()
         self.process_status_changes()
         self._check_craned_timeouts(now)
+        self._check_alloc_timeouts(now)
         self._renew_cancel_intents(now)
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
@@ -1087,6 +1397,7 @@ class JobScheduler:
         job.status = JobStatus.RUNNING
         job.start_time = now
         job.pending_reason = PendingReason.NONE
+        self._init_steps(job, now)
         self.running[job.job_id] = job
         if self.wal is not None:
             self.wal.job_started(job)
@@ -1100,8 +1411,12 @@ class JobScheduler:
         victim = self.running.get(victim_id)
         if victim is None:
             return
-        self.dispatch_terminate(victim_id, now,
-                                incarnation=victim.requeue_count)
+        if victim.spec.alloc_only:
+            self.dispatch_free_alloc(victim_id, now,
+                                     incarnation=victim.requeue_count)
+        else:
+            self.dispatch_terminate(victim_id, now,
+                                    incarnation=victim.requeue_count)
         self._release_job_resources(victim)
         del self.running[victim_id]
         self._cancel_kill_sent.pop(victim_id, None)
@@ -1390,6 +1705,7 @@ class JobScheduler:
             job.status = JobStatus.RUNNING
             job.start_time = now
             job.pending_reason = PendingReason.NONE
+            self._init_steps(job, now)
             self.running[job.job_id] = job
             if self.wal is not None:
                 self.wal.job_started(job)
@@ -1426,6 +1742,11 @@ class JobScheduler:
             elif job.status == JobStatus.RUNNING:
                 if self.meta.malloc_resource(job_id, job.node_ids,
                                              self._job_alloc(job)):
+                    if not job.spec.alloc_only and 0 not in job.steps:
+                        # WAL record predates the step model: re-create
+                        # the implicit batch step so step-level reports
+                        # from the still-running supervisors land
+                        self._init_steps(job, job.start_time or now)
                     self.licenses.restore(job.spec.licenses or {})
                     if (self.account_meta is not None and job.qos_name):
                         self.account_meta.restore_run(

@@ -30,6 +30,9 @@ from cranesched_tpu.ctld.defs import (
     JobStatus,
     PendingReason,
     ResourceSpec,
+    Step,
+    StepSpec,
+    StepStatus,
 )
 
 
@@ -110,7 +113,47 @@ def _job_to_dict(job: Job) -> dict:
         "array_children": job.array_children,
         "suspend_time": job.suspend_time,
         "suspended_total": job.suspended_total,
+        "next_step_id": job.next_step_id,
+        "steps": [_step_to_dict(s) for s in job.steps.values()],
     }
+
+
+def _step_to_dict(step: Step) -> dict:
+    sp = dataclasses.asdict(step.spec)
+    res = sp.pop("res")
+    sp["res"] = _res_to_dict(res) if res else None
+    return {
+        "step_id": step.step_id,
+        "spec": sp,
+        "submit_time": step.submit_time,
+        "status": step.status.name,
+        "start_time": step.start_time,
+        "end_time": step.end_time,
+        "exit_code": step.exit_code,
+        "node_ids": step.node_ids,
+        "node_reports": {str(k): [v[0].name, v[1]]
+                         for k, v in step.node_reports.items()},
+        "cancel_requested": step.cancel_requested,
+    }
+
+
+def _step_from_dict(d: dict) -> Step:
+    sp = dict(d["spec"])
+    res = sp.pop("res", None)
+    sp["res"] = _res_from_dict(res) if res else None
+    return Step(
+        step_id=d["step_id"],
+        spec=StepSpec(**sp),
+        submit_time=d["submit_time"],
+        status=StepStatus[d["status"]],
+        start_time=d["start_time"],
+        end_time=d["end_time"],
+        exit_code=d["exit_code"],
+        node_ids=list(d["node_ids"]),
+        node_reports={int(k): (StepStatus[v[0]], v[1])
+                      for k, v in (d.get("node_reports") or {}).items()},
+        cancel_requested=d.get("cancel_requested", False),
+    )
 
 
 def _job_from_dict(d: dict) -> Job:
@@ -144,6 +187,9 @@ def _job_from_dict(d: dict) -> Job:
         array_children=list(d.get("array_children") or ()),
         suspend_time=d.get("suspend_time"),
         suspended_total=d.get("suspended_total", 0.0),
+        next_step_id=d.get("next_step_id", 0),
+        steps={s["step_id"]: _step_from_dict(s)
+               for s in (d.get("steps") or ())},
     )
 
 

@@ -23,6 +23,19 @@ import time
 
 
 def main(argv=None) -> int:
+    # Make JAX_PLATFORMS effective even when a sitecustomize-registered
+    # accelerator plugin overrides it at import time (observed: the env
+    # var alone does not win; only config.update after import does).
+    # Without this, the first scheduling cycle can hang initializing an
+    # unreachable accelerator backend while holding the RPC lock.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:
+            pass  # backend already initialized — nothing to force
+
     ap = argparse.ArgumentParser(prog="cranectld")
     ap.add_argument("--config", "-c", required=True)
     ap.add_argument("--sim", action="store_true",
@@ -59,16 +72,10 @@ def main(argv=None) -> int:
         for node in meta.nodes.values():
             node.alive = True
         sim = SimCluster(scheduler)
-        scheduler.dispatch = sim.dispatch
-        scheduler.dispatch_terminate = sim.terminate
-        scheduler.dispatch_suspend = sim.suspend
-        scheduler.dispatch_resume = sim.resume
+        sim.wire(scheduler)
     else:
         dispatcher = GrpcDispatcher(scheduler)
-        scheduler.dispatch = dispatcher.dispatch
-        scheduler.dispatch_terminate = dispatcher.terminate
-        scheduler.dispatch_suspend = dispatcher.suspend
-        scheduler.dispatch_resume = dispatcher.resume
+        dispatcher.wire(scheduler)
 
     address = args.listen or cfg.listen
     server, port = serve(scheduler, sim=sim, address=address,

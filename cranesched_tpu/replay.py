@@ -51,8 +51,7 @@ def _build(num_nodes, cpu, mem_gb, layout_gres=(), partitions=("default",),
     sched = JobScheduler(meta, SchedulerConfig(**(config_kw or {})),
                          accounts=accounts)
     sim = SimCluster(sched)
-    sched.dispatch = sim.dispatch
-    sched.dispatch_terminate = sim.terminate
+    sim.wire(sched)
     return meta, sched, sim
 
 

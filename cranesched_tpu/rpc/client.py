@@ -122,15 +122,37 @@ class CtldClient:
                           pb.OkReply)
 
     def step_status_change(self, job_id, status, exit_code, time,
-                           node_id: int = -1,
-                           incarnation: int = 0) -> pb.OkReply:
-        return self._call(
-            "StepStatusChange",
-            pb.StepStatusChangeRequest(job_id=job_id, status=status,
-                                       exit_code=exit_code, time=time,
-                                       node_id=node_id,
-                                       incarnation=incarnation),
-            pb.OkReply)
+                           node_id: int = -1, incarnation: int = 0,
+                           step_id: int | None = None) -> pb.OkReply:
+        req = pb.StepStatusChangeRequest(job_id=job_id, status=status,
+                                         exit_code=exit_code, time=time,
+                                         node_id=node_id,
+                                         incarnation=incarnation)
+        if step_id is not None:
+            req.step_id = step_id
+        return self._call("StepStatusChange", req, pb.OkReply)
+
+    # ---- steps within an allocation ----
+
+    def submit_step(self, job_id: int,
+                    spec: pb.StepSpec) -> pb.SubmitStepReply:
+        return self._call("SubmitStep",
+                          pb.SubmitStepRequest(job_id=job_id, spec=spec),
+                          pb.SubmitStepReply)
+
+    def query_steps(self, job_id: int) -> pb.QueryStepsReply:
+        return self._call("QueryStepsInfo",
+                          pb.QueryStepsRequest(job_id=job_id),
+                          pb.QueryStepsReply)
+
+    def cancel_step(self, job_id: int, step_id: int) -> pb.OkReply:
+        return self._call("CancelStep",
+                          pb.JobIdRequest(job_id=job_id, step_id=step_id),
+                          pb.OkReply)
+
+    def free_allocation(self, job_id: int) -> pb.OkReply:
+        return self._call("FreeAllocation",
+                          pb.JobIdRequest(job_id=job_id), pb.OkReply)
 
     def tick(self, now: float) -> pb.TickReply:
         return self._call("Tick", pb.TickRequest(now=now), pb.TickReply)

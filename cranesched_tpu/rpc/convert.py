@@ -9,6 +9,8 @@ from cranesched_tpu.ctld.defs import (
     Job,
     JobSpec,
     ResourceSpec,
+    Step,
+    StepSpec,
 )
 from cranesched_tpu.rpc import crane_pb2 as pb
 
@@ -77,6 +79,7 @@ def spec_from_pb(msg) -> JobSpec:
         reservation=msg.reservation,
         script=msg.script,
         output_path=msg.output_path,
+        alloc_only=msg.alloc_only,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -99,6 +102,7 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         deps_is_or=spec.deps_is_or,
         reservation=spec.reservation,
         script=spec.script, output_path=spec.output_path,
+        alloc_only=spec.alloc_only,
         sim_runtime=spec.sim_runtime or 0.0,
         sim_exit_code=spec.sim_exit_code)
     if spec.task_res is not None:
@@ -112,6 +116,45 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
             stride=spec.array.stride,
             max_concurrent=spec.array.max_concurrent))
     return msg
+
+
+def step_spec_from_pb(msg) -> StepSpec:
+    return StepSpec(
+        name=msg.name or "step",
+        script=msg.script,
+        res=res_from_pb(msg.res) if msg.HasField("res") else None,
+        node_num=msg.node_num,
+        time_limit=msg.time_limit,
+        output_path=msg.output_path,
+        sim_runtime=msg.sim_runtime or None,
+        sim_exit_code=msg.sim_exit_code,
+    )
+
+
+def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
+    msg = pb.StepSpec(name=spec.name, script=spec.script,
+                      node_num=spec.node_num,
+                      time_limit=spec.time_limit,
+                      output_path=spec.output_path,
+                      sim_runtime=spec.sim_runtime or 0.0,
+                      sim_exit_code=spec.sim_exit_code)
+    if spec.res is not None:
+        msg.res.CopyFrom(res_to_pb(spec.res))
+    return msg
+
+
+def step_to_pb(job_id: int, step: Step, node_names) -> pb.StepInfo:
+    return pb.StepInfo(
+        job_id=job_id,
+        step_id=step.step_id,
+        name=step.spec.name,
+        status=step.status.value,
+        exit_code=step.exit_code or 0,
+        submit_time=step.submit_time,
+        start_time=step.start_time or 0.0,
+        end_time=step.end_time or 0.0,
+        node_names=[node_names[n] for n in step.node_ids],
+    )
 
 
 def job_to_pb(job: Job, node_names) -> pb.JobInfo:

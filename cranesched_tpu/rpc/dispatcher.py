@@ -7,7 +7,10 @@ Wire-up::
 
     dispatcher = GrpcDispatcher(scheduler)
     scheduler.dispatch = dispatcher.dispatch
+    scheduler.dispatch_step = dispatcher.dispatch_step
     scheduler.dispatch_terminate = dispatcher.terminate
+    scheduler.dispatch_terminate_step = dispatcher.terminate_step
+    scheduler.dispatch_free_alloc = dispatcher.free_alloc
     scheduler.dispatch_suspend = dispatcher.suspend
     scheduler.dispatch_resume = dispatcher.resume
     server = CtldServer(scheduler, dispatcher=dispatcher)
@@ -24,7 +27,7 @@ import grpc
 from cranesched_tpu.ctld.defs import Job, JobStatus
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.consts import CRANED_SERVICE
-from cranesched_tpu.rpc.convert import spec_to_pb
+from cranesched_tpu.rpc.convert import spec_to_pb, step_spec_to_pb
 from cranesched_tpu.rpc.stub import GrpcStub
 
 
@@ -45,6 +48,17 @@ class GrpcDispatcher:
         self._lock = threading.Lock()
         self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
 
+    def wire(self, scheduler) -> None:
+        """Attach every dispatch seam in one place (wiring the seams
+        individually has already produced a missed-seam bug once)."""
+        scheduler.dispatch = self.dispatch
+        scheduler.dispatch_step = self.dispatch_step
+        scheduler.dispatch_terminate = self.terminate
+        scheduler.dispatch_terminate_step = self.terminate_step
+        scheduler.dispatch_free_alloc = self.free_alloc
+        scheduler.dispatch_suspend = self.suspend
+        scheduler.dispatch_resume = self.resume
+
     def node_registered(self, node_id: int, address: str) -> None:
         with self._lock:
             old = self._stubs.get(node_id)
@@ -61,12 +75,20 @@ class GrpcDispatcher:
     # ---- the dispatch seam ----
 
     def dispatch(self, job: Job, node_ids: list[int]) -> None:
-        """ExecuteStep fan-out, ASYNCHRONOUS: the caller holds the ctld
-        lock, so pushes must not block on craned RPCs (an unreachable
-        craned would stall pings from healthy nodes and cascade false
-        CranedDown events).  A failed push fails the job via the normal
-        status-change path (the reference frees resources and marks
-        Failed on dispatch errors, JobScheduler.cpp:1908-1967)."""
+        """ExecuteStep/AllocJob fan-out, ASYNCHRONOUS: the caller holds
+        the ctld lock, so pushes must not block on craned RPCs (an
+        unreachable craned would stall pings from healthy nodes and
+        cascade false CranedDown events).  A failed push fails the job
+        via the normal status-change path (the reference frees resources
+        and marks Failed on dispatch errors, JobScheduler.cpp:1908-1967).
+
+        Batch jobs push ExecuteStep (implicit allocation + step 0 in
+        one); alloc_only jobs push AllocJob (the allocation sits until
+        steps arrive via dispatch_step)."""
+        verb = "AllocJob" if job.spec.alloc_only else "ExecuteStep"
+        step0 = job.steps.get(0)
+        step_pb = (step_spec_to_pb(step0.spec)
+                   if step0 is not None else None)
         spec_pb = spec_to_pb(job.spec)
         tasks = job.task_layout or [1] * len(node_ids)
         # capture the incarnation NOW, synchronously under the ctld lock:
@@ -83,14 +105,14 @@ class GrpcDispatcher:
             # transient refusals (e.g. GRES slots still held by a
             # previous incarnation mid-teardown) retry briefly
             for attempt in range(10):
+                req = pb.ExecuteStepRequest(
+                    job_id=job.job_id, spec=spec_pb,
+                    tasks_on_node=ntasks, now=time.time(),
+                    incarnation=incarnation, step_id=0)
+                if step_pb is not None:
+                    req.step.CopyFrom(step_pb)
                 try:
-                    reply = stub.call("ExecuteStep",
-                                      pb.ExecuteStepRequest(
-                                          job_id=job.job_id,
-                                          spec=spec_pb,
-                                          tasks_on_node=ntasks,
-                                          now=time.time(),
-                                          incarnation=incarnation))
+                    reply = stub.call(verb, req)
                 except grpc.RpcError as exc:
                     return f"push to node {node_id} failed: {exc.code()}"
                 if reply.ok:
@@ -104,12 +126,17 @@ class GrpcDispatcher:
             errors = [e for e in map(push, node_ids,
                                      tasks[: len(node_ids)]) if e]
             if errors:
-                # kill any step that did start — guarded by OUR
+                # roll back whatever DID land — guarded by OUR
                 # incarnation, so if the job was requeued and re-placed
                 # while a push blocked on its RPC timeout, this late
-                # cleanup cannot kill the healthy new incarnation
+                # cleanup cannot touch the healthy new incarnation.
+                # AllocJob pushes must be undone with FreeJob (an
+                # explicit allocation with zero steps ignores
+                # TerminateStep and would leak its cgroup + GRES).
+                undo = "FreeJob" if verb == "AllocJob" else \
+                    "TerminateStep"
                 for node_id in node_ids:
-                    self._try_call(node_id, "TerminateStep",
+                    self._try_call(node_id, undo,
                                    pb.JobIdRequest(job_id=job.job_id,
                                                    incarnation=incarnation))
                 self.scheduler.step_status_change(
@@ -117,6 +144,75 @@ class GrpcDispatcher:
                     incarnation=incarnation)
 
         self._pool.submit(fan_out)
+
+    def dispatch_step(self, job: Job, step) -> None:
+        """Push one step into an existing allocation (the AllocSteps
+        half).  Failure cancels just the step via step_report."""
+        spec_pb = spec_to_pb(job.spec)
+        step_pb = step_spec_to_pb(step.spec)
+        incarnation = job.requeue_count
+        node_ids = list(step.node_ids)
+        step_id = step.step_id
+
+        def push():
+            from cranesched_tpu.ctld.defs import StepStatus
+            errors = []
+            for node_id in node_ids:
+                stub = self._stub(node_id)
+                if stub is None:
+                    errors.append(f"node {node_id} has no stub")
+                    continue
+                req = pb.ExecuteStepRequest(
+                    job_id=job.job_id, spec=spec_pb, tasks_on_node=1,
+                    now=time.time(), incarnation=incarnation,
+                    step_id=step_id)
+                req.step.CopyFrom(step_pb)
+                try:
+                    reply = stub.call("ExecuteStep", req)
+                except grpc.RpcError as exc:
+                    errors.append(f"push to node {node_id}: {exc.code()}")
+                    continue
+                if not reply.ok:
+                    errors.append(reply.error)
+            if errors:
+                for node_id in node_ids:
+                    self._try_call(node_id, "TerminateStep",
+                                   pb.JobIdRequest(job_id=job.job_id,
+                                                   step_id=step_id,
+                                                   incarnation=incarnation))
+                # enqueue, never mutate: this runs on a pool thread
+                # without the server lock (step_report would race the
+                # cycle thread's _try_start_steps and WAL writes)
+                self.scheduler.step_report_async(
+                    job.job_id, step_id, StepStatus.FAILED, 254,
+                    time.time(), incarnation=incarnation)
+
+        self._pool.submit(push)
+
+    def terminate_step(self, job_id: int, step_id: int,
+                       now: float) -> None:
+        job = self.scheduler.running.get(job_id)
+        if job is None:
+            return
+        step = job.steps.get(step_id)
+        nodes = list(step.node_ids) if step is not None else []
+        incarnation = job.requeue_count
+        self._pool.submit(lambda: [
+            self._try_call(n, "TerminateStep",
+                           pb.JobIdRequest(job_id=job_id, step_id=step_id,
+                                           incarnation=incarnation))
+            for n in nodes])
+
+    def free_alloc(self, job_id: int, now: float,
+                   incarnation: int | None = None,
+                   skip_node: int | None = None) -> None:
+        """Release the allocation on every node (FreeJob fan-out)."""
+        nodes = [n for n in self._job_nodes(job_id) if n != skip_node]
+        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation)
+               if incarnation is not None
+               else pb.JobIdRequest(job_id=job_id))
+        self._pool.submit(lambda: [
+            self._try_call(n, "FreeJob", req) for n in nodes])
 
     def terminate(self, job_id: int, now: float,
                   incarnation: int | None = None,

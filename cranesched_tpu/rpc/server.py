@@ -25,11 +25,17 @@ from concurrent import futures
 import grpc
 
 from cranesched_tpu.craned.sim import SimCluster, SimCraned
-from cranesched_tpu.ctld.defs import JobStatus
+from cranesched_tpu.ctld.defs import JobStatus, StepStatus
 from cranesched_tpu.ctld.scheduler import JobScheduler
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.consts import SERVICE
-from cranesched_tpu.rpc.convert import job_to_pb, res_from_pb, spec_from_pb
+from cranesched_tpu.rpc.convert import (
+    job_to_pb,
+    res_from_pb,
+    spec_from_pb,
+    step_spec_from_pb,
+    step_to_pb,
+)
 
 
 def _node_state(node) -> str:
@@ -114,6 +120,43 @@ class CtldServer:
         with self._lock:
             ok = self.scheduler.resume(request.job_id, now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "not suspended")
+
+    def SubmitStep(self, request, context):
+        try:
+            spec = step_spec_from_pb(request.spec)
+        except ValueError as exc:
+            return pb.SubmitStepReply(step_id=-1, error=str(exc))
+        with self._lock:
+            step_id = self.scheduler.submit_step(request.job_id, spec,
+                                                 now=self._now())
+        return pb.SubmitStepReply(
+            step_id=step_id,
+            error="" if step_id >= 0 else "rejected (no such running "
+                                          "allocation or bad share)")
+
+    def QueryStepsInfo(self, request, context):
+        with self._lock:
+            names = {i: n.name
+                     for i, n in self.scheduler.meta.nodes.items()}
+            job = self.scheduler.job_info(request.job_id)
+            steps = (sorted(job.steps.values(), key=lambda s: s.step_id)
+                     if job is not None else [])
+            return pb.QueryStepsReply(
+                steps=[step_to_pb(request.job_id, s, names)
+                       for s in steps])
+
+    def CancelStep(self, request, context):
+        with self._lock:
+            ok = self.scheduler.cancel_step(
+                request.job_id, request.step_id, now=self._now())
+        return pb.OkReply(ok=ok, error="" if ok else "no such live step")
+
+    def FreeAllocation(self, request, context):
+        with self._lock:
+            ok = self.scheduler.free_allocation(request.job_id,
+                                                now=self._now())
+        return pb.OkReply(ok=ok,
+                          error="" if ok else "not a running allocation")
 
     def QueryJobsInfo(self, request, context):
         with self._lock:
@@ -354,11 +397,20 @@ class CtldServer:
 
     def StepStatusChange(self, request, context):
         with self._lock:
-            self.scheduler.step_status_change(
-                request.job_id, JobStatus(request.status),
-                request.exit_code, request.time,
-                node_id=request.node_id,
-                incarnation=request.incarnation)
+            if request.HasField("step_id"):
+                # step-level report (real craneds): routes through the
+                # per-step machine; batch step 0 closes the job
+                self.scheduler.step_report(
+                    request.job_id, request.step_id,
+                    StepStatus(request.status), request.exit_code,
+                    request.time, node_id=request.node_id,
+                    incarnation=request.incarnation)
+            else:
+                self.scheduler.step_status_change(
+                    request.job_id, JobStatus(request.status),
+                    request.exit_code, request.time,
+                    node_id=request.node_id,
+                    incarnation=request.incarnation)
         return pb.OkReply(ok=True)
 
     def Tick(self, request, context):
@@ -379,6 +431,10 @@ class CtldServer:
         "SuspendJob": (pb.JobIdRequest, pb.OkReply),
         "ResumeJob": (pb.JobIdRequest, pb.OkReply),
         "QueryJobsInfo": (pb.QueryJobsRequest, pb.QueryJobsReply),
+        "SubmitStep": (pb.SubmitStepRequest, pb.SubmitStepReply),
+        "QueryStepsInfo": (pb.QueryStepsRequest, pb.QueryStepsReply),
+        "CancelStep": (pb.JobIdRequest, pb.OkReply),
+        "FreeAllocation": (pb.JobIdRequest, pb.OkReply),
         "QueryClusterInfo": (pb.QueryClusterRequest, pb.QueryClusterReply),
         "CreateReservation": (pb.CreateReservationRequest, pb.OkReply),
         "DeleteReservation": (pb.NameRequest, pb.OkReply),

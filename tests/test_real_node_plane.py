@@ -30,10 +30,7 @@ def plane(tmp_path):
     sched = JobScheduler(meta, SchedulerConfig(
         backfill=False, craned_timeout=3.0))
     dispatcher = GrpcDispatcher(sched)
-    sched.dispatch = dispatcher.dispatch
-    sched.dispatch_terminate = dispatcher.terminate
-    sched.dispatch_suspend = dispatcher.suspend
-    sched.dispatch_resume = dispatcher.resume
+    dispatcher.wire(sched)
     server, port = serve(sched, cycle_interval=0.15,
                          dispatcher=dispatcher)
     ctld_addr = f"127.0.0.1:{port}"
@@ -204,10 +201,63 @@ def test_ping_timeout_marks_node_down_and_requeues(plane):
         lambda: sched.job_info(jid).status == JobStatus.RUNNING)
     # the step must actually land on the craned first (a dispatch still
     # in flight when the node dies is a dispatch FAILURE, not a requeue)
-    assert wait_for(lambda: jid in d._steps)
+    assert wait_for(lambda: (jid, 0) in d._steps)
     # kill the craned silently: pings stop, ctld must declare it down
     d.stop(graceful=False)
     assert wait_for(
         lambda: not sched.meta.node_by_name("pn00").alive, timeout=15.0)
     job = sched.job_info(jid)
     assert job.status == JobStatus.PENDING and job.requeue_count == 1
+
+
+def test_calloc_allocation_runs_three_real_steps(plane):
+    """A calloc-style allocation on a REAL craned runs 3 crun steps —
+    real supervisor processes, each with its own exit status — and the
+    allocation outlives them until freed (reference: AllocJobs vs
+    AllocSteps, JobScheduler.cpp:1732-1839; crun within calloc)."""
+    from cranesched_tpu.ctld import StepSpec
+    from cranesched_tpu.ctld.defs import StepStatus
+
+    sched, add_craned, tmp_path, _ = plane
+    d = add_craned("an00")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0),
+                               alloc_only=True, time_limit=300),
+                       now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.RUNNING)
+    # the explicit allocation lands on the craned without any supervisor
+    assert wait_for(lambda: jid in d._allocs)
+    assert not d._steps
+
+    out = tmp_path / "steps.txt"
+    share = ResourceSpec(cpu=1.0)
+    s0 = sched.submit_step(jid, StepSpec(
+        name="ok", res=share, script=f"echo step0 >> {out}; exit 0"),
+        now=time.time())
+    s1 = sched.submit_step(jid, StepSpec(
+        name="fail", res=share, script="exit 9"), now=time.time())
+    s2 = sched.submit_step(jid, StepSpec(
+        name="ok2", res=share, script=f"echo step2 >> {out}; exit 0"),
+        now=time.time())
+    assert (s0, s1, s2) == (0, 1, 2)
+    job = sched.job_info(jid)
+    assert wait_for(lambda: all(
+        job.steps[s].status.is_terminal for s in (s0, s1, s2)),
+        timeout=20.0)
+    assert job.steps[s0].status == StepStatus.COMPLETED
+    assert job.steps[s0].exit_code == 0
+    assert job.steps[s1].status == StepStatus.FAILED
+    assert job.steps[s1].exit_code == 9
+    assert job.steps[s2].status == StepStatus.COMPLETED
+    assert out.read_text().count("step") == 2
+    # allocation survives its steps (a failed crun must not kill it)
+    assert jid in sched.running
+    assert jid in d._allocs
+
+    # free the allocation: craned drops it, ledger restores, job done
+    assert sched.free_allocation(jid, now=time.time())
+    assert sched.job_info(jid).status == JobStatus.COMPLETED
+    assert wait_for(lambda: jid not in d._allocs)
+    node = sched.meta.node_by_name("an00")
+    assert wait_for(lambda: (node.avail == node.total).all())

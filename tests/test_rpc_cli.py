@@ -25,10 +25,7 @@ def ctld():
         meta.craned_up(i)
     sched = JobScheduler(meta, SchedulerConfig(backfill=False))
     sim = SimCluster(sched)
-    sched.dispatch = sim.dispatch
-    sched.dispatch_terminate = sim.terminate
-    sched.dispatch_suspend = sim.suspend
-    sched.dispatch_resume = sim.resume
+    sim.wire(sched)
     server, port = serve(sched, sim=sim, tick_mode=True)
     client = CtldClient(f"127.0.0.1:{port}")
     yield client, server, sched, port
@@ -182,3 +179,37 @@ def test_cli_cancel_and_control(ctld, capsys):
     assert rc == 0
     rc, out = run_cli(capsys, port, "ccancel", "999")
     assert rc == 1 and "no such job" in out.err
+
+
+def test_steps_over_wire(ctld):
+    """calloc-style allocation + crun steps over the RPC surface
+    (SubmitStep/QueryStepsInfo/CancelStep/FreeAllocation)."""
+    client, _, sched, _ = ctld
+    jid = client.submit(pb.JobSpec(
+        res=pb.ResourceSpec(cpu=4.0, mem_bytes=1 << 30),
+        alloc_only=True, time_limit=3600)).job_id
+    client.tick(0.0)
+    assert client.query_jobs(job_ids=[jid]).jobs[0].status == "Running"
+
+    share = pb.ResourceSpec(cpu=1.0)
+    s0 = client.submit_step(jid, pb.StepSpec(
+        name="a", res=share, sim_runtime=5.0)).step_id
+    s1 = client.submit_step(jid, pb.StepSpec(
+        name="b", res=share, sim_runtime=5.0, sim_exit_code=3)).step_id
+    assert (s0, s1) == (0, 1)
+    steps = client.query_steps(jid).steps
+    assert [s.status for s in steps] == ["Running", "Running"]
+
+    client.tick(10.0)
+    steps = {s.step_id: s for s in client.query_steps(jid).steps}
+    assert steps[s0].status == "Completed" and steps[s0].exit_code == 0
+    assert steps[s1].status == "Failed" and steps[s1].exit_code == 3
+
+    s2 = client.submit_step(jid, pb.StepSpec(
+        name="c", res=share, sim_runtime=1e6)).step_id
+    assert client.cancel_step(jid, s2).ok
+    assert client.free_allocation(jid).ok
+    jobs = client.query_jobs(job_ids=[jid], include_history=True).jobs
+    assert jobs[0].status == "Completed"
+    # rejected: no such allocation anymore
+    assert client.submit_step(jid, pb.StepSpec(name="late")).step_id == -1

@@ -1,0 +1,216 @@
+"""Job→step model: allocations hosting N steps.
+
+Reference: StepInCtld / Daemon- and CommonStepInCtld state machines
+(CtldPublicDefs.h:521-782), AllocJobs (allocation) vs AllocSteps
+(per-step dispatch, JobScheduler.cpp:1732-1839), step scheduling onto a
+live allocation (StepScheduleThread_, JobScheduler.cpp:1985), step-id
+counter reset on requeue (:6950-6965).
+
+The round's acceptance bar (VERDICT r2 #3): a calloc-style allocation
+runs 3 crun steps, each with its own exit status, WAL-recovered.
+"""
+
+import time
+
+import pytest
+
+from cranesched_tpu.ctld.defs import (
+    JobSpec,
+    JobStatus,
+    ResourceSpec,
+    StepSpec,
+    StepStatus,
+)
+from cranesched_tpu.ctld.meta import MetaContainer
+from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.craned.sim import SimCluster
+
+
+def make(num_nodes=2, cpu=8.0, wal=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"n{i}", meta.layout.encode(
+            cpu=cpu, mem_bytes=32 << 30, memsw_bytes=32 << 30,
+            is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False), wal=wal)
+    sim = SimCluster(sched)
+    sim.wire(sched)
+    return meta, sched, sim
+
+
+def start_alloc(sched, sim, now=0.0, **kw):
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0,
+                                                mem_bytes=4 << 30),
+                               alloc_only=True, time_limit=3600, **kw),
+                       now=now)
+    assert sched.schedule_cycle(now=now + 1.0) == [jid]
+    return jid
+
+
+def test_batch_job_has_implicit_step0():
+    meta, sched, sim = make()
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0),
+                               sim_runtime=30.0, script="echo hi"),
+                       now=0.0)
+    sched.schedule_cycle(now=1.0)
+    job = sched.running[jid]
+    assert list(job.steps) == [0]
+    assert job.steps[0].status == StepStatus.RUNNING
+    assert job.steps[0].spec.script == "echo hi"
+    sim.advance_to(40.0)
+    sched.schedule_cycle(now=41.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.COMPLETED
+    assert job.steps[0].status == StepStatus.COMPLETED
+    assert job.steps[0].exit_code == 0
+
+
+def test_calloc_three_steps_each_own_exit_status():
+    meta, sched, sim = make()
+    jid = start_alloc(sched, sim)
+    s0 = sched.submit_step(jid, StepSpec(name="a", sim_runtime=5.0,
+                                         sim_exit_code=0), now=2.0)
+    s1 = sched.submit_step(jid, StepSpec(name="b", sim_runtime=5.0,
+                                         sim_exit_code=7), now=2.0)
+    s2 = sched.submit_step(jid, StepSpec(name="c", sim_runtime=5.0,
+                                         sim_exit_code=0), now=2.0)
+    assert (s0, s1, s2) == (0, 1, 2)
+    job = sched.running[jid]
+    # default share = whole allocation -> steps serialize: only s0 runs
+    assert job.steps[s0].status == StepStatus.RUNNING
+    assert job.steps[s1].status == StepStatus.PENDING
+    assert job.steps[s2].status == StepStatus.PENDING
+    t = 3.0
+    for _ in range(10):
+        sim.advance_to(t)
+        sched.schedule_cycle(now=t)
+        t += 5.0
+        if all(job.steps[s].status.is_terminal for s in (s0, s1, s2)):
+            break
+    assert job.steps[s0].status == StepStatus.COMPLETED
+    assert job.steps[s0].exit_code == 0
+    assert job.steps[s1].status == StepStatus.FAILED
+    assert job.steps[s1].exit_code == 7
+    assert job.steps[s2].status == StepStatus.COMPLETED
+    assert job.steps[s2].exit_code == 0
+    # a failed step does NOT fail the allocation (reference: a crun
+    # failing does not kill the calloc)
+    assert jid in sched.running
+    assert sched.free_allocation(jid, now=t)
+    assert sched.job_info(jid).status == JobStatus.COMPLETED
+
+
+def test_sized_steps_pack_concurrently():
+    meta, sched, sim = make(num_nodes=1)
+    jid = start_alloc(sched, sim)
+    small = ResourceSpec(cpu=2.0, mem_bytes=1 << 30)
+    s0 = sched.submit_step(jid, StepSpec(res=small, sim_runtime=50.0),
+                           now=2.0)
+    s1 = sched.submit_step(jid, StepSpec(res=small, sim_runtime=50.0),
+                           now=2.0)
+    s2 = sched.submit_step(jid, StepSpec(res=small, sim_runtime=50.0),
+                           now=2.0)
+    job = sched.running[jid]
+    # 4 cpu allocation, 2 cpu each -> two run, third waits
+    assert job.steps[s0].status == StepStatus.RUNNING
+    assert job.steps[s1].status == StepStatus.RUNNING
+    assert job.steps[s2].status == StepStatus.PENDING
+    # a step larger than the allocation is rejected outright
+    assert sched.submit_step(
+        jid, StepSpec(res=ResourceSpec(cpu=8.0)), now=3.0) == -1
+    sim.advance_to(60.0)
+    sched.schedule_cycle(now=61.0)
+    assert job.steps[s2].status == StepStatus.RUNNING
+
+
+def test_cancel_single_step_leaves_allocation_alive():
+    meta, sched, sim = make()
+    jid = start_alloc(sched, sim)
+    small = ResourceSpec(cpu=1.0)
+    s0 = sched.submit_step(jid, StepSpec(res=small, sim_runtime=500.0),
+                           now=2.0)
+    s1 = sched.submit_step(jid, StepSpec(res=small, sim_runtime=500.0),
+                           now=2.0)
+    assert sched.cancel_step(jid, s0, now=3.0)
+    job = sched.running[jid]
+    assert job.steps[s0].status == StepStatus.CANCELLED
+    assert job.steps[s1].status == StepStatus.RUNNING
+    assert jid in sched.running
+
+
+def test_alloc_only_time_limit_enforced_by_ctld():
+    meta, sched, sim = make()
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0),
+                               alloc_only=True, time_limit=100),
+                       now=0.0)
+    sched.schedule_cycle(now=1.0)
+    sched.submit_step(jid, StepSpec(sim_runtime=1e9), now=2.0)
+    sched.schedule_cycle(now=50.0)
+    assert jid in sched.running
+    sched.schedule_cycle(now=102.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.EXCEED_TIME_LIMIT
+    assert all(s.status.is_terminal for s in job.steps.values())
+    # ledger restored
+    assert all((n.avail == n.total).all() for n in meta.nodes.values())
+
+
+def test_cancel_alloc_only_job_finalizes_synchronously():
+    meta, sched, sim = make()
+    jid = start_alloc(sched, sim)
+    sched.submit_step(jid, StepSpec(sim_runtime=1e9), now=2.0)
+    assert sched.cancel(jid, now=3.0)
+    job = sched.job_info(jid)
+    assert job.status == JobStatus.CANCELLED
+    assert all(s.status.is_terminal for s in job.steps.values())
+    assert all((n.avail == n.total).all() for n in meta.nodes.values())
+
+
+def test_steps_wal_recovered(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = WriteAheadLog(path, fsync=False)
+    meta, sched, sim = make(wal=wal)
+    jid = start_alloc(sched, sim)
+    s0 = sched.submit_step(jid, StepSpec(name="done", sim_runtime=2.0,
+                                         sim_exit_code=5), now=2.0)
+    sim.advance_to(10.0)
+    sched.schedule_cycle(now=11.0)
+    s1 = sched.submit_step(jid, StepSpec(name="live", sim_runtime=1e9),
+                           now=12.0)
+    job = sched.running[jid]
+    assert job.steps[s0].status == StepStatus.FAILED
+    assert job.steps[s1].status == StepStatus.RUNNING
+    wal.close()
+
+    # crash + recover: the allocation re-adopts with BOTH steps — the
+    # finished one keeps its own exit status, the live one stays running
+    meta2, sched2, sim2 = make()
+    sched2.recover(WriteAheadLog.replay(path), now=20.0)
+    job2 = sched2.running[jid]
+    assert job2.spec.alloc_only
+    assert job2.steps[s0].status == StepStatus.FAILED
+    assert job2.steps[s0].exit_code == 5
+    assert job2.steps[s1].status == StepStatus.RUNNING
+    assert job2.next_step_id == 2
+    # new steps keep monotonic ids after recovery
+    assert sched2.submit_step(jid, StepSpec(sim_runtime=1.0),
+                              now=21.0) == 2
+
+
+def test_step_ids_reset_on_requeue():
+    meta, sched, sim = make(num_nodes=3)
+    kills = []
+    sched.dispatch = lambda job, nodes: None
+    sched.dispatch_terminate = lambda jid, now, **kw: kills.append(jid)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=2.0),
+                               node_num=2, sim_runtime=1e9), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert list(sched.running[jid].steps) == [0]
+    dead = sched.running[jid].node_ids[0]
+    sched.on_craned_down(dead, now=5.0)
+    job = sched.pending[jid]
+    assert job.steps == {} and job.next_step_id == 0
+    sched.schedule_cycle(now=6.0)
+    assert list(sched.running[jid].steps) == [0]  # fresh step 0
