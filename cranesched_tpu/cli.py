@@ -217,24 +217,86 @@ def cmd_cstep(args) -> int:
     return 0
 
 
-def _run_step_in_alloc(args, client) -> int:
-    """crun --jobid: submit a step into a live allocation and follow it
-    via the step table + its output file."""
-    import tempfile
-    import time as _time
+def _stream_session(sess, cancel, status_poll=None) -> int:
+    """Pump a StepIO session to this terminal: output chunks to
+    stdout/stderr as they arrive, local stdin forwarded to the step,
+    Ctrl-C -> cancel intent -> drain remaining output -> cancelled code.
+    Output is structurally drained before the exit status arrives
+    (reference CforedClient.h:60-63).
+
+    ``status_poll`` (-> (terminal, exit_code) from the ctld) is the
+    liveness fallback: if the job/step dies before any supervisor ever
+    connects (dispatch failure, cancel while pending, node death), no
+    stream will end the session — the watchdog aborts it with the
+    recorded exit code instead of hanging forever."""
+    import threading
+
+    def watchdog():
+        import time as _time
+        grace_until = None
+        while not sess.exited.wait(1.0):
+            try:
+                terminal, code = status_poll()
+            except Exception:
+                continue
+            if not terminal:
+                grace_until = None
+                continue
+            # terminal at ctld: give an in-flight exited chunk a
+            # moment to land, then abort the wait
+            if grace_until is None:
+                grace_until = _time.monotonic() + 3.0
+            elif _time.monotonic() > grace_until:
+                sess.abort(code if code is not None else 1)
+                return
+
+    if status_poll is not None:
+        threading.Thread(target=watchdog, daemon=True).start()
+
+    def stdin_pump():
+        try:
+            while True:
+                data = sys.stdin.buffer.readline()
+                if not data:
+                    sess.close_stdin()
+                    return
+                sess.send_stdin(data)
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=stdin_pump, daemon=True).start()
+
+    def drain():
+        for name, data in sess.read():
+            stream = sys.stdout if name == "out" else sys.stderr
+            stream.buffer.write(data)
+            stream.flush()
+
+    try:
+        drain()
+    except KeyboardInterrupt:
+        cancel()
+        try:
+            drain()
+        except KeyboardInterrupt:
+            pass  # second ^C: stop draining
+        print("\ncrun: cancelled", file=sys.stderr)
+        return sess.exit_code if sess.exit_code is not None else 130
+    return sess.exit_code if sess.exit_code is not None else 1
+
+
+def _run_step_in_alloc(args, client, cfored) -> int:
+    """crun --jobid: an interactive STEP inside a live allocation,
+    streaming over the embedded CraneFored service."""
     from cranesched_tpu.rpc import crane_pb2 as pb
-    cleanup_path = None
-    if not args.output:
-        fd, args.output = tempfile.mkstemp(prefix="crun_step_",
-                                           suffix=".out")
-        os.close(fd)
-        cleanup_path = args.output
     # -N maps 1:1 onto the step's node span (0 = every allocation node);
     # the default -N 1 therefore means exactly one node, matching the
     # standalone crun semantics
     spec = pb.StepSpec(name=args.job_name, script=args.script,
                        node_num=args.nodes,
-                       time_limit=args.time, output_path=args.output)
+                       time_limit=args.time,
+                       interactive_address=cfored.address,
+                       pty=args.pty)
     if args.cpu or args.mem != "0":
         spec.res.CopyFrom(pb.ResourceSpec(
             cpu=args.cpu, mem_bytes=_parse_mem(args.mem)))
@@ -243,99 +305,60 @@ def _run_step_in_alloc(args, client) -> int:
         print(f"crun: step rejected: {reply.error}", file=sys.stderr)
         return 1
     step_id = reply.step_id
-    out_path = args.output.replace("%j", str(args.jobid))
-    offset, exit_code = 0, 0
-    try:
-        while True:
-            steps = [s for s in client.query_steps(args.jobid).steps
-                     if s.step_id == step_id]
-            status = steps[0].status if steps else "?"
-            try:
-                with open(out_path, "rb") as fh:
-                    fh.seek(offset)
-                    chunk = fh.read()
-                if chunk:
-                    sys.stdout.write(chunk.decode(errors="replace"))
-                    sys.stdout.flush()
-                    offset += len(chunk)
-            except OSError:
-                pass
-            if status not in ("Pending", "Running"):
-                exit_code = steps[0].exit_code if steps else 1
-                break
-            _time.sleep(args.poll)
-    except KeyboardInterrupt:
-        client.cancel_step(args.jobid, step_id)
-        print(f"\ncrun: step {args.jobid}.{step_id} cancelled",
-              file=sys.stderr)
-        return 130
-    finally:
-        if cleanup_path is not None:
-            try:
-                os.unlink(cleanup_path)
-            except OSError:
-                pass
-    return exit_code
+    sess = cfored.expect(args.jobid, step_id)
+
+    def status_poll():
+        steps = [s for s in client.query_steps(args.jobid).steps
+                 if s.step_id == step_id]
+        if not steps:
+            return True, 1
+        s = steps[0]
+        return s.status not in ("Pending", "Running"), s.exit_code
+
+    return _stream_session(
+        sess, cancel=lambda: client.cancel_step(args.jobid, step_id),
+        status_poll=status_poll)
 
 
 def cmd_crun(args) -> int:
-    """Interactive-style run: submit, wait, stream the output file.
-
-    With ``--jobid`` the command becomes a STEP inside an existing
-    calloc allocation (reference crun within calloc).  Streams via the
-    shared filesystem (the reference likewise assumes shared storage for
-    job output; its cfored bidi-stream I/O hub is the
-    network-transparent variant of this seam)."""
-    import tempfile
-    import time as _time
-    if args.jobid:
-        return _run_step_in_alloc(args, _client(args))
-    cleanup_path = None
-    if not args.output:
-        fd, args.output = tempfile.mkstemp(prefix="crun_",
-                                           suffix=".out")
-        os.close(fd)
-        cleanup_path = args.output
-    spec = _build_spec(args)
+    """Interactive run with REAL bidi streaming: the client hosts an
+    embedded CraneFored service; the supervisor connects back and
+    streams stdout/stderr while accepting stdin -- no shared storage
+    (reference cfored protocol, Crane.proto:794-900,1679).  With
+    ``--jobid`` the command becomes a STEP inside an existing calloc
+    allocation (reference crun within calloc)."""
+    from cranesched_tpu.rpc.cfored import CforedServer
     client = _client(args)
-    reply = client.submit(spec)
-    if not reply.job_id:
-        print(f"crun: submit failed: {reply.error}", file=sys.stderr)
-        return 1
-    job_id = reply.job_id
-    out_path = args.output.replace("%j", str(job_id))
-    offset = 0
-    exit_code = 0
+    cfored = CforedServer()
+    cfored.start(host_for_clients=args.bind_host)
     try:
-        while True:
+        if args.jobid:
+            return _run_step_in_alloc(args, client, cfored)
+        spec = _build_spec(args)
+        spec.interactive_address = cfored.address
+        spec.pty = args.pty
+        reply = client.submit(spec)
+        if not reply.job_id:
+            print(f"crun: submit failed: {reply.error}",
+                  file=sys.stderr)
+            return 1
+        job_id = reply.job_id
+        sess = cfored.expect(job_id, 0)
+
+        def status_poll():
             jobs = client.query_jobs(job_ids=[job_id],
                                      include_history=True).jobs
-            status = jobs[0].status if jobs else "?"
-            try:
-                with open(out_path, "rb") as fh:
-                    fh.seek(offset)
-                    chunk = fh.read()
-                if chunk:
-                    sys.stdout.write(chunk.decode(errors="replace"))
-                    sys.stdout.flush()
-                    offset += len(chunk)
-            except OSError:
-                pass
-            if status not in ("Pending", "Running", "Suspended"):
-                exit_code = jobs[0].exit_code if jobs else 1
-                break
-            _time.sleep(args.poll)
-    except KeyboardInterrupt:
-        client.cancel(job_id)
-        print(f"\ncrun: job {job_id} cancelled", file=sys.stderr)
-        return 130
+            if not jobs:
+                return True, 1
+            j = jobs[0]
+            return (j.status not in ("Pending", "Running", "Suspended"),
+                    j.exit_code)
+
+        return _stream_session(sess,
+                               cancel=lambda: client.cancel(job_id),
+                               status_poll=status_poll)
     finally:
-        if cleanup_path is not None:
-            try:
-                os.unlink(cleanup_path)
-            except OSError:
-                pass
-    return exit_code
+        cfored.stop()
 
 
 def cmd_cqueue(args) -> int:
@@ -529,10 +552,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--time", "-t", type=int, default=3600)
     p.add_argument("--qos", "-q", default="")
     p.add_argument("--reservation", default="")
-    p.add_argument("--output", "-o", default="")
-    p.add_argument("--poll", type=float, default=0.3)
     p.add_argument("--jobid", type=int, default=0,
                    help="run as a STEP inside this calloc allocation")
+    p.add_argument("--pty", action="store_true",
+                   help="run the command on a pseudo-terminal")
+    p.add_argument("--bind-host", default="127.0.0.1",
+                   help="address craneds use to reach this client's "
+                        "I/O stream (set to a routable IP/hostname on "
+                        "multi-host clusters)")
     p.set_defaults(func=cmd_crun)
 
     p = sub.add_parser("calloc",

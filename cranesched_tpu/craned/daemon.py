@@ -312,8 +312,11 @@ class CranedDaemon:
                     "retryable: allocation of a previous incarnation "
                     "still tearing down")
         # GRES first: nothing else to clean up if the pool can't satisfy
+        # CRANE_JOB_NODELIST = the whole gang (compressed);
+        # CRANE_NODE_NAME = this node (the SLURMD_NODENAME analog)
         env = {"CRANE_JOB_NAME": spec.name,
-               "CRANE_JOB_NODELIST": self.name}
+               "CRANE_JOB_NODELIST": request.nodelist or self.name,
+               "CRANE_NODE_NAME": self.name}
         gres_held = self._assign_gres(spec, env)
         if gres_held is None:
             # a re-dispatch can overlap the previous incarnation's
@@ -376,6 +379,19 @@ class CranedDaemon:
         step_env["CRANE_STEP_ID"] = str(step_id)
         if step_spec and step_spec.name:
             step_env["CRANE_STEP_NAME"] = step_spec.name
+        # gang rendezvous env (the PMIx fork-env role, Pmix.h:54-57):
+        # every member can enumerate the gang and find the coordinator.
+        # Per-REQUEST values (rank differs per node; a step's span can
+        # be a subset of the allocation's).
+        if request.nodelist:
+            step_env["CRANE_JOB_NODELIST"] = request.nodelist
+            step_env["CRANE_NODE_RANK"] = str(request.node_rank)
+            step_env["CRANE_NNODES"] = str(request.nnodes)
+            step_env["CRANE_NTASKS"] = str(request.ntasks)
+            if request.rendezvous:
+                step_env["CRANE_RENDEZVOUS"] = request.rendezvous
+        step_env["CRANE_NTASKS_ON_NODE"] = str(request.tasks_on_node
+                                               or 1)
         # the supervisor must import this package regardless of workdir
         import cranesched_tpu
         import os
@@ -389,11 +405,17 @@ class CranedDaemon:
             [sys.executable, "-m", "cranesched_tpu.craned.supervisor"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             cwd=self.workdir, env=env)
+        cfored = ((step_spec.interactive_address
+                   if step_spec and step_spec.interactive_address
+                   else spec.interactive_address) or "")
+        use_pty = bool((step_spec.pty if step_spec else False)
+                       or spec.pty)
         init = dict(
-            job_id=job_id, script=script,
+            job_id=job_id, step_id=step_id, script=script,
             output_path=output_path,
             time_limit=time_limit,
             env=step_env,
+            cfored=cfored, pty=use_pty,
             cgroup_procs=alloc.procs_path)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import signal
 import subprocess
 import sys
@@ -35,6 +36,154 @@ def _substitute(pattern: str, job_id: int) -> str:
     return pattern.replace("%j", str(job_id))
 
 
+class _InteractiveIO:
+    """Streams the child's stdout/stderr to the client's embedded
+    CraneFored service and feeds stdin back (the reference's
+    CforedClient role, CforedClient.h:28-95).
+
+    Ordering contract: the final ``exited`` chunk is enqueued only
+    after BOTH output readers hit EOF, so the client provably receives
+    every output byte before the exit status (CforedClient.h:60-63)."""
+
+    def __init__(self, address: str, job_id: int, step_id: int,
+                 use_pty: bool):
+        self.address = address
+        self.job_id = job_id
+        self.step_id = step_id
+        self.use_pty = use_pty
+        self._q: queue.Queue = queue.Queue()
+        self._readers: list[threading.Thread] = []
+        self._call = None
+        self._child = None
+        self._pty_master = None
+
+    def spawn(self, script: str, env: dict) -> subprocess.Popen:
+        if self.use_pty:
+            import pty
+            master, slave = pty.openpty()
+            self._pty_master = master
+            child = subprocess.Popen(
+                ["bash", "-c", script], stdin=slave, stdout=slave,
+                stderr=slave, env=env, start_new_session=True)
+            os.close(slave)
+            t = threading.Thread(target=self._read_fd,
+                                 args=(master, "out"), daemon=True)
+            t.start()
+            self._readers = [t]
+        else:
+            child = subprocess.Popen(
+                ["bash", "-c", script], stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=env, start_new_session=True)
+            self._readers = [
+                threading.Thread(target=self._read_pipe,
+                                 args=(child.stdout, "out"), daemon=True),
+                threading.Thread(target=self._read_pipe,
+                                 args=(child.stderr, "err"), daemon=True),
+            ]
+            for t in self._readers:
+                t.start()
+        self._child = child
+        self._connect()
+        return child
+
+    def _read_pipe(self, fh, name: str) -> None:
+        for chunk in iter(lambda: fh.read1(65536), b""):
+            self._q.put((name, chunk))
+
+    def _read_fd(self, fd: int, name: str) -> None:
+        while True:
+            try:
+                chunk = os.read(fd, 65536)
+            except OSError:   # EIO at pty EOF
+                return
+            if not chunk:
+                return
+            self._q.put((name, chunk))
+
+    def _connect(self) -> None:
+        import grpc
+        from cranesched_tpu.rpc import crane_pb2 as pb
+        from cranesched_tpu.rpc.consts import CFORED_SERVICE
+
+        channel = grpc.insecure_channel(self.address)
+
+        def requests():
+            yield pb.StepIOChunk(job_id=self.job_id,
+                                 step_id=self.step_id)
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                if isinstance(item, tuple):
+                    name, data = item
+                    yield pb.StepIOChunk(job_id=self.job_id,
+                                         step_id=self.step_id,
+                                         stream=name, data=data)
+                else:  # the final exited chunk
+                    yield item
+                    return
+
+        self._call = channel.stream_stream(
+            f"/{CFORED_SERVICE}/StepIO",
+            request_serializer=pb.StepIOChunk.SerializeToString,
+            response_deserializer=pb.StepIOChunk.FromString)(requests())
+
+        def stdin_loop():
+            import grpc as _grpc
+            try:
+                for chunk in self._call:
+                    if chunk.stdin_eof:
+                        self._close_stdin()
+                    elif chunk.data:
+                        self._write_stdin(chunk.data)
+            except _grpc.RpcError:
+                pass
+
+        threading.Thread(target=stdin_loop, daemon=True).start()
+
+    def _write_stdin(self, data: bytes) -> None:
+        try:
+            if self._pty_master is not None:
+                os.write(self._pty_master, data)
+            elif self._child and self._child.stdin:
+                self._child.stdin.write(data)
+                self._child.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+
+    def _close_stdin(self) -> None:
+        try:
+            if self._pty_master is not None:
+                os.write(self._pty_master, b"\x04")  # EOT on the pty
+            elif self._child and self._child.stdin:
+                self._child.stdin.close()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+
+    def finish(self, exit_code: int) -> None:
+        """Drain the readers, then send the exited chunk.
+
+        The join has a short grace rather than waiting for pipe EOF
+        unconditionally: a backgrounded grandchild that inherited the
+        pipes would otherwise stall every such step for the full
+        timeout.  Ordering therefore covers all output written by the
+        step before it exited (plus the grace window); output a
+        detached grandchild produces later is dropped — the same
+        boundary the reference draws by killing the step's cgroup."""
+        from cranesched_tpu.rpc import crane_pb2 as pb
+        deadline = time.monotonic() + 2.0
+        for t in self._readers:
+            t.join(timeout=max(deadline - time.monotonic(), 0.05))
+        self._q.put(pb.StepIOChunk(job_id=self.job_id,
+                                   step_id=self.step_id, exited=True,
+                                   exit_code=exit_code))
+        if self._call is not None:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not self._call.done():
+                time.sleep(0.05)
+
+
 def main() -> int:
     init = json.loads(sys.stdin.readline())
     job_id = init["job_id"]
@@ -43,19 +192,28 @@ def main() -> int:
     env = dict(os.environ, **(init.get("env") or {}),
                CRANE_JOB_ID=str(job_id))
 
-    out_path = _substitute(init.get("output_path") or "/dev/null", job_id)
-    if out_path != "/dev/null":
-        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    out = open(out_path, "ab", buffering=0)
+    interactive = None
+    if init.get("cfored"):
+        interactive = _InteractiveIO(init["cfored"], job_id,
+                                     int(init.get("step_id") or 0),
+                                     bool(init.get("pty")))
 
     print("READY", flush=True)
     go = sys.stdin.readline().strip()
     if go != "GO":
         return 1
 
-    child = subprocess.Popen(
-        ["bash", "-c", script], stdout=out, stderr=out, env=env,
-        start_new_session=True)
+    if interactive is not None:
+        child = interactive.spawn(script, env)
+    else:
+        out_path = _substitute(init.get("output_path") or "/dev/null",
+                               job_id)
+        if out_path != "/dev/null":
+            os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        out = open(out_path, "ab", buffering=0)
+        child = subprocess.Popen(
+            ["bash", "-c", script], stdout=out, stderr=out, env=env,
+            start_new_session=True)
     # optional cgroup attachment (the craned pre-created the cgroup and
     # passed its cgroup.procs path)
     procs_path = init.get("cgroup_procs")
@@ -111,9 +269,15 @@ def main() -> int:
             except ProcessLookupError:
                 pass
             child.wait()
+            if interactive is not None:
+                interactive.finish(124)
             print("TIMEOUT", flush=True)
             return 0
 
+    if interactive is not None:
+        # readers drained + exited chunk sent BEFORE the craned report:
+        # the client always has the full output when the exit lands
+        interactive.finish(130 if state["terminated"] else code)
     if state["terminated"]:
         print("KILLED", flush=True)
     else:

@@ -151,6 +151,11 @@ class StepSpec:
     node_num: int = 0
     time_limit: int = 0
     output_path: str = ""
+    # interactive I/O: the submitting client's embedded CraneFored
+    # endpoint; the supervisor streams stdout/stderr there and accepts
+    # stdin (reference CforedClient, CforedClient.h:28-95)
+    interactive_address: str = ""
+    pty: bool = False
     # simulation-only (real planes learn these from the supervisor)
     sim_runtime: float | None = None
     sim_exit_code: int = 0
@@ -224,6 +229,10 @@ class JobSpec:
     # on FreeAllocation / cancel / time limit (reference InteractiveMeta
     # + calloc semantics, CtldPublicDefs.h:282)
     alloc_only: bool = False
+    # interactive batch (crun without an allocation): step 0 streams to
+    # this client-side CraneFored endpoint instead of output files
+    interactive_address: str = ""
+    pty: bool = False
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None

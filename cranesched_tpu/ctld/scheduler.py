@@ -717,6 +717,8 @@ class JobScheduler:
                           res=None, node_num=0,
                           time_limit=spec.time_limit,
                           output_path=spec.output_path,
+                          interactive_address=spec.interactive_address,
+                          pty=spec.pty,
                           sim_runtime=spec.sim_runtime,
                           sim_exit_code=spec.sim_exit_code),
             submit_time=now, status=StepStatus.RUNNING,

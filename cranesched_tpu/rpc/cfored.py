@@ -1,0 +1,178 @@
+"""Client-embedded interactive I/O hub: the cfored role.
+
+The reference runs a standalone user-side ``cfored`` daemon holding a
+bidi ``CforedStream`` to ctld plus per-step ``StepIOStream``s from
+supervisors (reference: protos/Crane.proto:794-900,1679;
+src/Craned/Supervisor/CforedClient.h:28-95).  Here the hub is embedded
+in the submitting client (crun/calloc): it hosts the ``CraneFored``
+gRPC service, the submitting spec carries its address, and each
+supervisor connects back with one ``StepIO`` bidi stream.
+
+Ordering contract (CforedClient.h:60-63 — output drained before exit
+status): the supervisor sends the final ``exited`` chunk only after
+both output pipes reached EOF, so by construction a client that reads
+the stream in order has seen every output byte before the exit code.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent import futures
+
+import grpc
+
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.consts import CFORED_SERVICE
+
+
+class StepIOSession:
+    """One supervisor's live stream, as seen by the client.
+
+    ``read()`` yields (stream-name, bytes) chunks until the step exits;
+    ``exit_code`` is set once the final chunk arrived.  ``send_stdin``/
+    ``close_stdin`` feed the response direction.
+    """
+
+    def __init__(self, job_id: int, step_id: int):
+        self.job_id = job_id
+        self.step_id = step_id
+        self.exit_code: int | None = None
+        self._out: queue.Queue = queue.Queue()
+        self._stdin: queue.Queue = queue.Queue()
+        self.exited = threading.Event()
+        # ordering observability: bytes received before the exited
+        # chunk — equals the total output iff the drained-before-exit
+        # contract held (chunks arrive in stream order)
+        self.bytes_received = 0
+        self.bytes_at_exit: int | None = None
+
+    # -- client side --
+
+    def read(self, timeout: float | None = None):
+        """Yield (stream, bytes) until the exited chunk; sets exit_code."""
+        while True:
+            item = self._out.get(timeout=timeout)
+            if item is None:
+                return
+            yield item
+
+    def send_stdin(self, data: bytes) -> None:
+        self._stdin.put(pb.StepIOChunk(data=data))
+
+    def close_stdin(self) -> None:
+        self._stdin.put(pb.StepIOChunk(stdin_eof=True))
+
+    def abort(self, exit_code: int) -> None:
+        """Client-side liveness fallback: end the session when no
+        supervisor will ever stream (the job died before dispatch, a
+        stale cancel landed, the node vanished pre-connect).  No-op if
+        the stream already finished."""
+        if self.exited.is_set():
+            return
+        self.exit_code = exit_code
+        self.exited.set()
+        self._out.put(None)
+        self._stdin.put(None)
+
+    # -- handler side --
+
+    def _push_output(self, chunk) -> None:
+        if chunk.exited:
+            if self.exited.is_set():
+                return  # already aborted client-side
+            self.exit_code = chunk.exit_code
+            self.bytes_at_exit = self.bytes_received
+            self.exited.set()
+            self._out.put(None)
+            self._stdin.put(None)  # unblock the response generator
+        elif chunk.data:
+            self.bytes_received += len(chunk.data)
+            self._out.put((chunk.stream or "out", chunk.data))
+
+    def _stdin_iter(self):
+        while True:
+            item = self._stdin.get()
+            if item is None:
+                return
+            yield item
+
+
+class CforedServer:
+    """Hosts CraneFored; hands incoming supervisor streams to waiters.
+
+    ``expect(job_id, step_id)`` registers interest and returns the
+    session (created on first use from either side, so the supervisor
+    connecting before/after expect() both work).
+    """
+
+    def __init__(self):
+        self._sessions: dict[tuple[int, int], StepIOSession] = {}
+        self._lock = threading.Lock()
+        self._server: grpc.Server | None = None
+        self.address = ""
+
+    def _session(self, job_id: int, step_id: int) -> StepIOSession:
+        with self._lock:
+            key = (job_id, step_id)
+            sess = self._sessions.get(key)
+            if sess is None:
+                sess = self._sessions[key] = StepIOSession(job_id,
+                                                           step_id)
+            return sess
+
+    expect = _session
+
+    def StepIO(self, request_iterator, context):
+        """Bidi handler: a thread drains the supervisor's output chunks
+        into the session; this generator yields stdin chunks back."""
+        first = next(request_iterator, None)
+        if first is None:
+            return
+        sess = self._session(first.job_id, first.step_id)
+        sess._push_output(first)
+
+        def drain():
+            try:
+                for chunk in request_iterator:
+                    sess._push_output(chunk)
+            except grpc.RpcError:
+                pass
+            finally:
+                if not sess.exited.is_set():
+                    # supervisor died mid-stream: release both sides
+                    sess.exit_code = sess.exit_code or 255
+                    sess.exited.set()
+                    sess._out.put(None)
+                    sess._stdin.put(None)
+
+        threading.Thread(target=drain, daemon=True).start()
+        yield from sess._stdin_iter()
+
+    def start(self, address: str | None = None,
+              host_for_clients: str = "127.0.0.1") -> str:
+        """Bind and advertise.  When ``host_for_clients`` is not
+        loopback the listen socket must be reachable on that interface,
+        so the bind follows it (0.0.0.0); plain loopback stays bound to
+        loopback."""
+        if address is None:
+            address = ("127.0.0.1:0"
+                       if host_for_clients in ("127.0.0.1", "localhost")
+                       else "0.0.0.0:0")
+        handler = grpc.stream_stream_rpc_method_handler(
+            self.StepIO,
+            request_deserializer=pb.StepIOChunk.FromString,
+            response_serializer=pb.StepIOChunk.SerializeToString)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(
+                CFORED_SERVICE, {"StepIO": handler}),))
+        port = self._server.add_insecure_port(address)
+        self._server.start()
+        self.address = f"{host_for_clients}:{port}"
+        return self.address
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)

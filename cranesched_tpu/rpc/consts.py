@@ -3,3 +3,4 @@ the CLI can import the client without pulling the scheduler + JAX)."""
 
 SERVICE = "cranesched.CraneCtld"
 CRANED_SERVICE = "cranesched.Craned"
+CFORED_SERVICE = "cranesched.CraneFored"

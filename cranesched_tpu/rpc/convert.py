@@ -80,6 +80,8 @@ def spec_from_pb(msg) -> JobSpec:
         script=msg.script,
         output_path=msg.output_path,
         alloc_only=msg.alloc_only,
+        interactive_address=msg.interactive_address,
+        pty=msg.pty,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -103,6 +105,8 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         reservation=spec.reservation,
         script=spec.script, output_path=spec.output_path,
         alloc_only=spec.alloc_only,
+        interactive_address=spec.interactive_address,
+        pty=spec.pty,
         sim_runtime=spec.sim_runtime or 0.0,
         sim_exit_code=spec.sim_exit_code)
     if spec.task_res is not None:
@@ -126,6 +130,8 @@ def step_spec_from_pb(msg) -> StepSpec:
         node_num=msg.node_num,
         time_limit=msg.time_limit,
         output_path=msg.output_path,
+        interactive_address=msg.interactive_address,
+        pty=msg.pty,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -136,6 +142,8 @@ def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
                       node_num=spec.node_num,
                       time_limit=spec.time_limit,
                       output_path=spec.output_path,
+                      interactive_address=spec.interactive_address,
+                      pty=spec.pty,
                       sim_runtime=spec.sim_runtime or 0.0,
                       sim_exit_code=spec.sim_exit_code)
     if spec.res is not None:

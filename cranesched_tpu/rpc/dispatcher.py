@@ -91,6 +91,8 @@ class GrpcDispatcher:
                    if step0 is not None else None)
         spec_pb = spec_to_pb(job.spec)
         tasks = job.task_layout or [1] * len(node_ids)
+        gang = self._gang_ctx(job.job_id, node_ids,
+                              int(sum(tasks[: len(node_ids)])))
         # capture the incarnation NOW, synchronously under the ctld lock:
         # the async fan_out below can outlive a requeue (node death while
         # a push blocks on its RPC timeout), and a stale failure report
@@ -108,7 +110,12 @@ class GrpcDispatcher:
                 req = pb.ExecuteStepRequest(
                     job_id=job.job_id, spec=spec_pb,
                     tasks_on_node=ntasks, now=time.time(),
-                    incarnation=incarnation, step_id=0)
+                    incarnation=incarnation, step_id=0,
+                    nodelist=gang["nodelist"],
+                    node_rank=gang["rank"][node_id],
+                    nnodes=len(node_ids),
+                    ntasks=gang["ntasks"],
+                    rendezvous=gang["rendezvous"])
                 if step_pb is not None:
                     req.step.CopyFrom(step_pb)
                 try:
@@ -145,6 +152,28 @@ class GrpcDispatcher:
 
         self._pool.submit(fan_out)
 
+    def _gang_ctx(self, job_id: int, node_ids: list[int],
+                  ntasks: int, step_id: int = 0) -> dict:
+        """Per-gang rendezvous context (the PMIx role per SURVEY §2.4):
+        compressed nodelist, per-node rank, and a deterministic
+        rank-0 rendezvous endpoint — enough for members to enumerate
+        each other and bootstrap a jax.distributed-style init."""
+        from cranesched_tpu.utils.hostlist import compress_hostlist
+        nodes = self.scheduler.meta.nodes
+        names = [nodes[n].name if n in nodes else f"?{n}"
+                 for n in node_ids]
+        # deterministic per-(job, step) port in a high range: two
+        # concurrent steps of one allocation must not share a
+        # coordinator endpoint; collisions need two live gangs whose
+        # mixed ids land 20k apart on a shared rank-0 host
+        port = 28000 + ((job_id * 131 + step_id) % 20000)
+        return {
+            "nodelist": compress_hostlist(names),
+            "rank": {n: i for i, n in enumerate(node_ids)},
+            "ntasks": ntasks,
+            "rendezvous": f"{names[0]}:{port}" if names else "",
+        }
+
     def dispatch_step(self, job: Job, step) -> None:
         """Push one step into an existing allocation (the AllocSteps
         half).  Failure cancels just the step via step_report."""
@@ -153,6 +182,8 @@ class GrpcDispatcher:
         incarnation = job.requeue_count
         node_ids = list(step.node_ids)
         step_id = step.step_id
+        gang = self._gang_ctx(job.job_id, node_ids, len(node_ids),
+                              step_id=step_id)
 
         def push():
             from cranesched_tpu.ctld.defs import StepStatus
@@ -165,7 +196,12 @@ class GrpcDispatcher:
                 req = pb.ExecuteStepRequest(
                     job_id=job.job_id, spec=spec_pb, tasks_on_node=1,
                     now=time.time(), incarnation=incarnation,
-                    step_id=step_id)
+                    step_id=step_id,
+                    nodelist=gang["nodelist"],
+                    node_rank=gang["rank"][node_id],
+                    nnodes=len(node_ids),
+                    ntasks=gang["ntasks"],
+                    rendezvous=gang["rendezvous"])
                 req.step.CopyFrom(step_pb)
                 try:
                     reply = stub.call("ExecuteStep", req)

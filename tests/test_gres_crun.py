@@ -146,8 +146,7 @@ def test_crun_streams_real_output(tmp_path):
     meta = MetaContainer()
     sched = JobScheduler(meta, SchedulerConfig(backfill=False))
     dispatcher = GrpcDispatcher(sched)
-    sched.dispatch = dispatcher.dispatch
-    sched.dispatch_terminate = dispatcher.terminate
+    dispatcher.wire(sched)
     server, port = serve(sched, cycle_interval=0.15,
                          dispatcher=dispatcher)
     d = CranedDaemon("crn0", f"127.0.0.1:{port}", cpu=4.0,
@@ -160,14 +159,15 @@ def test_crun_streams_real_output(tmp_path):
         while d.state != CranedState.READY and time.time() < deadline:
             time.sleep(0.05)
         env = dict(os.environ, PYTHONPATH="/root/repo")
-        out = tmp_path / "crun_%j.out"
+        # no --output and no shared storage: the output arrives over
+        # the embedded CraneFored bidi stream
         r = subprocess.run(
             [sys.executable, "-m", "cranesched_tpu.cli",
              "--server", f"127.0.0.1:{port}", "crun",
              "echo streamed-$CRANE_JOB_ID; exit 4",
-             "--cpu", "1", "--output", str(out)],
+             "--cpu", "1"],
             capture_output=True, text=True, env=env, cwd="/root/repo",
-            timeout=60)
+            timeout=60, stdin=subprocess.DEVNULL)
         assert "streamed-1" in r.stdout
         assert r.returncode == 4          # child's exit code propagates
     finally:

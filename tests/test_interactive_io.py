@@ -1,0 +1,186 @@
+"""Interactive step I/O: supervisor → client streaming without shared
+storage (reference: the cfored bidi-stream protocol, Crane.proto:794-900
++ StepIOStream :1679; supervisor-side CforedClient with
+output-drained-before-exit ordering, CforedClient.h:28-95,60-63).
+
+Here the hub is embedded in the client (rpc/cfored.CforedServer); the
+spec carries its address; each supervisor connects back with one StepIO
+bidi stream.  Tests run the REAL plane: actual craned daemons, actual
+supervisor processes, a real gRPC stream."""
+
+import time
+
+import pytest
+
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+    StepSpec,
+)
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.cfored import CforedServer
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=3.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    cfored = CforedServer()
+    cfored.start()
+    craneds = []
+
+    def add_craned(name):
+        d = CranedDaemon(name, f"127.0.0.1:{port}", cpu=8.0,
+                         mem_bytes=8 << 30, workdir=str(tmp_path),
+                         ping_interval=0.5,
+                         cgroup_root=str(tmp_path / "nocgroup"))
+        d.start()
+        craneds.append(d)
+        return d
+
+    yield sched, add_craned, cfored
+    for d in craneds:
+        d.stop()
+    cfored.stop()
+    dispatcher.close()
+    server.stop()
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def collect(sess, timeout=20.0):
+    """Read the whole session; returns (bytes-by-stream, exit_code)."""
+    outs = {"out": b"", "err": b""}
+    for name, data in sess.read(timeout=timeout):
+        outs[name] += data
+    return outs, sess.exit_code
+
+
+def test_interactive_crun_streams_without_shared_storage(plane):
+    sched, add_craned, cfored = plane
+    d = add_craned("io00")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script="echo to-stdout; echo to-stderr >&2; exit 4",
+        interactive_address=cfored.address), now=time.time())
+    sess = cfored.expect(jid, 0)
+    outs, code = collect(sess)
+    assert outs["out"] == b"to-stdout\n"
+    assert outs["err"] == b"to-stderr\n"
+    assert code == 4
+    # the job record agrees with the streamed status
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.FAILED)
+    assert sched.job_info(jid).exit_code == 4
+
+
+def test_stdin_roundtrip(plane):
+    sched, add_craned, cfored = plane
+    d = add_craned("io01")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script="while read line; do echo got:$line; done",
+        interactive_address=cfored.address), now=time.time())
+    sess = cfored.expect(jid, 0)
+    sess.send_stdin(b"alpha\n")
+    sess.send_stdin(b"beta\n")
+    sess.close_stdin()
+    outs, code = collect(sess)
+    assert outs["out"] == b"got:alpha\ngot:beta\n"
+    assert code == 0
+
+
+def test_output_drained_before_exit_status(plane):
+    """A large burst right before a fast exit must still arrive, in
+    full, before the exit chunk (CforedClient.h:60-63)."""
+    sched, add_craned, cfored = plane
+    d = add_craned("io02")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    n = 20000
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script=f"seq 1 {n}; exit 0",
+        interactive_address=cfored.address), now=time.time())
+    sess = cfored.expect(jid, 0)
+    chunks = [data for _, data in sess.read(timeout=30.0)]
+    text = b"".join(chunks)
+    lines = text.decode().split()
+    assert len(lines) == n and lines[-1] == str(n)
+    assert sess.exit_code == 0
+    # the ordering contract itself: every output byte was RECEIVED by
+    # the hub strictly before the exited chunk (deterministic — chunks
+    # arrive in stream order, counted at arrival time)
+    assert sess.bytes_at_exit == len(text)
+
+
+def test_interactive_step_in_allocation_and_cancel(plane):
+    """crun step inside a calloc allocation streams too; a client-side
+    cancel (Ctrl-C analog) kills the step and the stream ends with the
+    cancelled status."""
+    sched, add_craned, cfored = plane
+    d = add_craned("io03")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0),
+                               alloc_only=True, time_limit=300),
+                       now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.RUNNING)
+    sid = sched.submit_step(jid, StepSpec(
+        res=ResourceSpec(cpu=1.0),
+        script="echo started; sleep 60",
+        interactive_address=cfored.address), now=time.time())
+    sess = cfored.expect(jid, sid)
+    # wait for the first output, then cancel — the Ctrl-C path
+    got = next(iter(sess.read(timeout=20.0)))
+    assert got == ("out", b"started\n")
+    assert sched.cancel_step(jid, sid, now=time.time())
+    for _ in sess.read(timeout=20.0):
+        pass
+    assert sess.exit_code == 130
+    assert wait_for(lambda: sched.job_info(jid)
+                    .steps[sid].status.value == "Cancelled")
+    # the allocation survives the cancelled interactive step
+    assert jid in sched.running
+    assert sched.free_allocation(jid, now=time.time())
+
+
+def test_stream_session_watchdog_ends_wait_when_job_dies_unconnected():
+    """If the job dies before any supervisor connects (dispatch failure,
+    cancel-while-pending, node death), no stream will ever end the
+    session — the crun watchdog must abort the wait with the recorded
+    exit code instead of hanging forever."""
+    from cranesched_tpu import cli as _cli
+
+    cfored = CforedServer()
+    cfored.start()
+    try:
+        sess = cfored.expect(7, 0)
+        t0 = time.time()
+        rc = _cli._stream_session(
+            sess, cancel=lambda: None,
+            status_poll=lambda: (True, 17))   # terminal at ctld
+        took = time.time() - t0
+        assert rc == 17
+        assert took < 10.0                    # bounded, not forever
+    finally:
+        cfored.stop()

@@ -176,7 +176,7 @@ def test_gang_one_node_fails_kills_the_rest(plane):
                     and d2.state == CranedState.READY)
     jid = sched.submit(JobSpec(
         res=ResourceSpec(cpu=4.0), node_num=2,
-        script='[ "$CRANE_JOB_NODELIST" = fn00 ] && exit 3; sleep 60'),
+        script='[ "$CRANE_NODE_NAME" = fn00 ] && exit 3; sleep 60'),
         now=time.time())
     assert wait_for(
         lambda: sched.job_info(jid).status == JobStatus.FAILED,
@@ -261,3 +261,40 @@ def test_calloc_allocation_runs_three_real_steps(plane):
     assert wait_for(lambda: jid not in d._allocs)
     node = sched.meta.node_by_name("an00")
     assert wait_for(lambda: (node.avail == node.total).all())
+
+
+def test_gang_rendezvous_env_lets_members_enumerate_each_other(plane):
+    """Every gang member sees the full compressed nodelist, its own
+    rank, the gang size, and a shared rendezvous endpoint — the
+    jax.distributed-style bootstrap contract replacing the reference's
+    PMIx fork-env (Pmix.h:54-57; SURVEY §2.4)."""
+    sched, add_craned, tmp_path, _ = plane
+    daemons = [add_craned(f"gv{i:02d}") for i in range(4)]
+    assert wait_for(lambda: all(d.state == CranedState.READY
+                                for d in daemons))
+    out = tmp_path / "gang_env.txt"
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=2.0), node_num=4,
+        script=(f"echo $CRANE_NODE_RANK/$CRANE_NNODES"
+                f"@$CRANE_JOB_NODELIST@$CRANE_RENDEZVOUS >> {out}")),
+        now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.COMPLETED,
+        timeout=20.0)
+    assert wait_for(lambda: out.exists()
+                    and len(out.read_text().splitlines()) == 4)
+    lines = sorted(out.read_text().splitlines())
+    ranks, nodelists, rdv = set(), set(), set()
+    for line in lines:
+        rank_part, nodelist, endpoint = line.split("@")
+        rank, nnodes = rank_part.split("/")
+        assert nnodes == "4"
+        ranks.add(int(rank))
+        nodelists.add(nodelist)
+        rdv.add(endpoint)
+    assert ranks == {0, 1, 2, 3}          # each member a distinct rank
+    assert len(nodelists) == 1            # same gang view everywhere
+    assert nodelists == {"gv[00-03]"}     # compressed hostlist
+    assert len(rdv) == 1                  # one shared coordinator
+    host, port = rdv.pop().split(":")
+    assert host == "gv00" and port.isdigit()
