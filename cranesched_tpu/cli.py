@@ -72,9 +72,55 @@ def _parse_dependency(text: str):
     return deps
 
 
+def _token(args) -> str:
+    """--token > $CRANE_TOKEN > ~/.crane/token (empty = no auth)."""
+    if getattr(args, "token", ""):
+        return args.token
+    env = os.environ.get("CRANE_TOKEN", "")
+    if env:
+        return env
+    path = os.path.expanduser("~/.crane/token")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read().strip()
+    except OSError:
+        return ""
+
+
 def _client(args):
     from cranesched_tpu.rpc.client import CtldClient
-    return CtldClient(args.server)
+    return CtldClient(args.server, token=_token(args))
+
+
+def cmd_ctoken(args) -> int:
+    """Admin: issue (or revoke) a user's bearer token (the reference's
+    SignUserCertificate / RevokeCert flow, AccountManager.h:171)."""
+    client = _client(args)
+    if args.revoke:
+        reply = client.revoke_token(args.user)
+        if reply.ok:
+            print(f"tokens of {args.user} revoked")
+            return 0
+        print(f"ctoken: {reply.error}", file=sys.stderr)
+        return 1
+    reply = client.issue_token(args.user)
+    if not reply.ok:
+        print(f"ctoken: {reply.error}", file=sys.stderr)
+        return 1
+    if args.save:
+        # per-user path: saving another user's token must never
+        # clobber the CALLER's own ~/.crane/token (the _token fallback
+        # would silently re-identify the admin as that user)
+        path = os.path.expanduser(f"~/.crane/token.{args.user}")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            fh.write(reply.token)
+        print(f"token for {args.user} saved to {path} "
+              f"(move to ~/.crane/token on {args.user}'s account)")
+    else:
+        print(reply.token)
+    return 0
 
 
 def _fmt_table(rows, headers) -> str:
@@ -296,6 +342,7 @@ def _run_step_in_alloc(args, client, cfored) -> int:
                        node_num=args.nodes,
                        time_limit=args.time,
                        interactive_address=cfored.address,
+                       interactive_token=cfored.secret,
                        pty=args.pty)
     if args.cpu or args.mem != "0":
         spec.res.CopyFrom(pb.ResourceSpec(
@@ -336,6 +383,7 @@ def cmd_crun(args) -> int:
             return _run_step_in_alloc(args, client, cfored)
         spec = _build_spec(args)
         spec.interactive_address = cfored.address
+        spec.interactive_token = cfored.secret
         spec.pty = args.pty
         reply = client.submit(spec)
         if not reply.job_id:
@@ -502,6 +550,9 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--server",
                      default=os.environ.get("CRANE_SERVER",
                                             "127.0.0.1:50051"))
+    top.add_argument("--token", default="",
+                     help="bearer token (default: $CRANE_TOKEN or "
+                          "~/.crane/token)")
     sub = top.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("cbatch", help="submit a batch job")
@@ -585,6 +636,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("cfree", help="release a calloc allocation")
     p.add_argument("job_id", type=int)
     p.set_defaults(func=cmd_cfree)
+
+    p = sub.add_parser("ctoken",
+                       help="issue/revoke user tokens (admin)")
+    p.add_argument("user")
+    p.add_argument("--revoke", action="store_true")
+    p.add_argument("--save", action="store_true",
+                   help="write the issued token to ~/.crane/token")
+    p.set_defaults(func=cmd_ctoken)
 
     p = sub.add_parser("cstep", help="list a job's steps")
     p.add_argument("job_id", type=int)

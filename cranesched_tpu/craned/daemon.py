@@ -80,7 +80,8 @@ class CranedDaemon:
                  cgroup_root: str = "/sys/fs/cgroup",
                  health_program: str = "",
                  health_interval: float = 30.0,
-                 gres: dict | None = None):
+                 gres: dict | None = None,
+                 token: str = ""):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -111,7 +112,9 @@ class CranedDaemon:
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = CgroupV2(cgroup_root)
-        self._ctld = CtldClient(ctld_address, timeout=10.0)
+        # cluster-secret token for the ctld's craned-internal surface
+        # (auth-enabled clusters refuse unauthenticated registration)
+        self._ctld = CtldClient(ctld_address, timeout=10.0, token=token)
         # allocations (job-level: cgroup + GRES) and the steps running
         # inside them, keyed (job_id, step_id)
         self._allocs: dict[int, _Alloc] = {}
@@ -408,6 +411,9 @@ class CranedDaemon:
         cfored = ((step_spec.interactive_address
                    if step_spec and step_spec.interactive_address
                    else spec.interactive_address) or "")
+        cfored_token = ((step_spec.interactive_token
+                         if step_spec and step_spec.interactive_token
+                         else spec.interactive_token) or "")
         use_pty = bool((step_spec.pty if step_spec else False)
                        or spec.pty)
         init = dict(
@@ -415,7 +421,7 @@ class CranedDaemon:
             output_path=output_path,
             time_limit=time_limit,
             env=step_env,
-            cfored=cfored, pty=use_pty,
+            cfored=cfored, cfored_token=cfored_token, pty=use_pty,
             cgroup_procs=alloc.procs_path)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
@@ -620,6 +626,13 @@ class CranedDaemon:
                 pb.CranedRegisterReply)
         except grpc.RpcError:
             return False
+        if not reply.ok and reply.error:
+            # surface the refusal reason once per change — without this
+            # an auth-misconfigured craned retries forever silently
+            if reply.error != getattr(self, "_last_refusal", None):
+                self._last_refusal = reply.error
+                print(f"craned {self.name}: registration refused: "
+                      f"{reply.error}", file=sys.stderr, flush=True)
         if reply.ok:
             self.node_id = reply.node_id
             # kill stale local steps ctld no longer expects (reference

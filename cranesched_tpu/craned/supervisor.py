@@ -46,11 +46,12 @@ class _InteractiveIO:
     every output byte before the exit status (CforedClient.h:60-63)."""
 
     def __init__(self, address: str, job_id: int, step_id: int,
-                 use_pty: bool):
+                 use_pty: bool, token: str = ""):
         self.address = address
         self.job_id = job_id
         self.step_id = step_id
         self.use_pty = use_pty
+        self.token = token
         self._q: queue.Queue = queue.Queue()
         self._readers: list[threading.Thread] = []
         self._call = None
@@ -109,8 +110,12 @@ class _InteractiveIO:
         channel = grpc.insecure_channel(self.address)
 
         def requests():
+            # the header presents the per-submission stream secret —
+            # the hub rejects streams that cannot (anyone reaching the
+            # client's port could otherwise claim the session)
             yield pb.StepIOChunk(job_id=self.job_id,
-                                 step_id=self.step_id)
+                                 step_id=self.step_id,
+                                 token=self.token)
             while True:
                 item = self._q.get()
                 if item is None:
@@ -196,7 +201,8 @@ def main() -> int:
     if init.get("cfored"):
         interactive = _InteractiveIO(init["cfored"], job_id,
                                      int(init.get("step_id") or 0),
-                                     bool(init.get("pty")))
+                                     bool(init.get("pty")),
+                                     token=init.get("cfored_token") or "")
 
     print("READY", flush=True)
     go = sys.stdin.readline().strip()

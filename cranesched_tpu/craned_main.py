@@ -28,7 +28,17 @@ def main(argv=None) -> int:
     ap.add_argument("--health-interval", type=float, default=30.0)
     ap.add_argument("--gres", default="",
                     help="name[:type]:count, comma-separated")
+    ap.add_argument("--token", default="",
+                    help="cluster secret for auth-enabled ctlds "
+                         "(the @craned entry in the token table)")
+    ap.add_argument("--token-file", default="",
+                    help="read the cluster secret's token from a file")
     args = ap.parse_args(argv)
+
+    token = args.token
+    if not token and args.token_file:
+        with open(args.token_file, encoding="utf-8") as fh:
+            token = fh.read().strip()
 
     from cranesched_tpu.craned.daemon import CranedDaemon
     from cranesched_tpu.utils.config import parse_mem
@@ -46,7 +56,7 @@ def main(argv=None) -> int:
         cgroup_root=args.cgroup_root,
         health_program=args.health_program,
         health_interval=args.health_interval,
-        gres=gres)
+        gres=gres, token=token)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

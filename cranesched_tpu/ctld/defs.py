@@ -153,9 +153,11 @@ class StepSpec:
     output_path: str = ""
     # interactive I/O: the submitting client's embedded CraneFored
     # endpoint; the supervisor streams stdout/stderr there and accepts
-    # stdin (reference CforedClient, CforedClient.h:28-95)
+    # stdin (reference CforedClient, CforedClient.h:28-95).  The token
+    # is the per-submission stream secret the first chunk must present.
     interactive_address: str = ""
     pty: bool = False
+    interactive_token: str = ""
     # simulation-only (real planes learn these from the supervisor)
     sim_runtime: float | None = None
     sim_exit_code: int = 0
@@ -233,6 +235,7 @@ class JobSpec:
     # this client-side CraneFored endpoint instead of output files
     interactive_address: str = ""
     pty: bool = False
+    interactive_token: str = ""
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None

@@ -719,6 +719,7 @@ class JobScheduler:
                           output_path=spec.output_path,
                           interactive_address=spec.interactive_address,
                           pty=spec.pty,
+                          interactive_token=spec.interactive_token,
                           sim_runtime=spec.sim_runtime,
                           sim_exit_code=spec.sim_exit_code),
             submit_time=now, status=StepStatus.RUNNING,

@@ -77,10 +77,21 @@ def main(argv=None) -> int:
         dispatcher = GrpcDispatcher(scheduler)
         dispatcher.wire(scheduler)
 
+    auth = None
+    if cfg.auth_token_file:
+        from cranesched_tpu.ctld.auth import AuthManager
+        os.makedirs(os.path.dirname(cfg.auth_token_file) or ".",
+                    exist_ok=True)
+        auth = AuthManager(cfg.auth_token_file,
+                           admins=tuple(cfg.auth_admins),
+                           accounts=scheduler.accounts)
+        print(f"auth enabled (token table {cfg.auth_token_file}; "
+              f"root + craned tokens inside)", flush=True)
+
     address = args.listen or cfg.listen
     server, port = serve(scheduler, sim=sim, address=address,
                          cycle_interval=args.cycle_interval,
-                         dispatcher=dispatcher)
+                         dispatcher=dispatcher, auth=auth)
     print(f"cranectld [{cfg.cluster_name}] listening on port {port} "
           f"({'simulated' if args.sim else 'real'} node plane, "
           f"{len(meta.nodes)} nodes configured)", flush=True)

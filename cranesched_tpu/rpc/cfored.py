@@ -104,9 +104,19 @@ class CforedServer:
     ``expect(job_id, step_id)`` registers interest and returns the
     session (created on first use from either side, so the supervisor
     connecting before/after expect() both work).
+
+    ``secret`` is the hub-wide stream credential: it exists before any
+    submission (no job-id ordering problem), every spec this client
+    submits carries it (``interactive_token``), and the first chunk of
+    every incoming stream must present it — without it, any peer that
+    can reach the port could claim a session (read the user's stdin,
+    forge the exit status).  Empty = open hub (tests, trusted loopback).
     """
 
-    def __init__(self):
+    def __init__(self, secret: str | None = None):
+        import secrets as _secrets
+        self.secret = (_secrets.token_urlsafe(16) if secret is None
+                       else secret)
         self._sessions: dict[tuple[int, int], StepIOSession] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
@@ -126,9 +136,13 @@ class CforedServer:
     def StepIO(self, request_iterator, context):
         """Bidi handler: a thread drains the supervisor's output chunks
         into the session; this generator yields stdin chunks back."""
+        import grpc as _grpc
         first = next(request_iterator, None)
         if first is None:
             return
+        if self.secret and first.token != self.secret:
+            context.abort(_grpc.StatusCode.PERMISSION_DENIED,
+                          "bad stream token")
         sess = self._session(first.job_id, first.step_id)
         sess._push_output(first)
 

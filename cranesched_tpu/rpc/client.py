@@ -9,10 +9,11 @@ from cranesched_tpu.rpc.stub import GrpcStub
 
 
 class CtldClient:
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(self, address: str, timeout: float = 30.0,
+                 token: str = ""):
         self.address = address
         self.timeout = timeout
-        self._stub = GrpcStub(address, SERVICE, timeout)
+        self._stub = GrpcStub(address, SERVICE, timeout, token=token)
         # kept for tests that introspect the channel
         self._channel = self._stub._channel
 
@@ -153,6 +154,14 @@ class CtldClient:
     def free_allocation(self, job_id: int) -> pb.OkReply:
         return self._call("FreeAllocation",
                           pb.JobIdRequest(job_id=job_id), pb.OkReply)
+
+    def issue_token(self, user: str) -> pb.TokenReply:
+        return self._call("IssueToken", pb.IssueTokenRequest(user=user),
+                          pb.TokenReply)
+
+    def revoke_token(self, user: str) -> pb.OkReply:
+        return self._call("RevokeToken",
+                          pb.IssueTokenRequest(user=user), pb.OkReply)
 
     def tick(self, now: float) -> pb.TickReply:
         return self._call("Tick", pb.TickRequest(now=now), pb.TickReply)

@@ -82,6 +82,7 @@ def spec_from_pb(msg) -> JobSpec:
         alloc_only=msg.alloc_only,
         interactive_address=msg.interactive_address,
         pty=msg.pty,
+        interactive_token=msg.interactive_token,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -107,6 +108,7 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         alloc_only=spec.alloc_only,
         interactive_address=spec.interactive_address,
         pty=spec.pty,
+        interactive_token=spec.interactive_token,
         sim_runtime=spec.sim_runtime or 0.0,
         sim_exit_code=spec.sim_exit_code)
     if spec.task_res is not None:
@@ -132,6 +134,7 @@ def step_spec_from_pb(msg) -> StepSpec:
         output_path=msg.output_path,
         interactive_address=msg.interactive_address,
         pty=msg.pty,
+        interactive_token=msg.interactive_token,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -144,6 +147,7 @@ def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
                       output_path=spec.output_path,
                       interactive_address=spec.interactive_address,
                       pty=spec.pty,
+                      interactive_token=spec.interactive_token,
                       sim_runtime=spec.sim_runtime or 0.0,
                       sim_exit_code=spec.sim_exit_code)
     if spec.res is not None:

@@ -59,12 +59,16 @@ class CtldServer:
     def __init__(self, scheduler: JobScheduler,
                  sim: SimCluster | None = None,
                  cycle_interval: float = 1.0, tick_mode: bool = False,
-                 dispatcher=None):
+                 dispatcher=None, auth=None):
         self.scheduler = scheduler
         self.sim = sim
         # real node plane: per-node push stubs (wired into the
         # scheduler's dispatch seam by the caller)
         self.dispatcher = dispatcher
+        # AuthManager (ctld/auth.py) or None = open system (the
+        # reference's equivalent seam is CheckCertAndUIDAllowed_ on
+        # every external RPC, CtldGrpcServer.h:568)
+        self.auth = auth
         self.cycle_interval = cycle_interval
         self.tick_mode = tick_mode
         self._lock = threading.Lock()
@@ -72,13 +76,79 @@ class CtldServer:
         self._cycle_thread: threading.Thread | None = None
         self._stop = threading.Event()
 
+    # ---- authentication helpers ----
+
+    def _ident(self, context) -> str | None:
+        """Authenticated identity of the caller, or None.  With auth
+        disabled returns the sentinel "" meaning 'trust the claim'."""
+        if self.auth is None:
+            return ""
+        return self.auth.identity(context.invocation_metadata())
+
+    def _deny_job_mutation(self, ident, job_id) -> str:
+        """Owner-or-admin check for job mutations; returns the denial
+        message or ''."""
+        if self.auth is None:
+            return ""
+        if ident is None:
+            return "authentication required"
+        job = self.scheduler.job_info(job_id)
+        if job is None:
+            return ""  # fall through: handler reports no-such-job
+        if not self.auth.may_act_on_job(ident, job):
+            return f"permission denied (job belongs to {job.spec.user})"
+        return ""
+
+    def _require_authenticated(self, ident, context) -> None:
+        """Read surface: any authenticated identity suffices, but an
+        anonymous caller must not enumerate jobs/steps/topology
+        (the information-disclosure half of the cert check).  Aborts
+        the RPC — queries have no error field to carry a denial."""
+        if self.auth is not None and ident is None:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "authentication required")
+
+    def _deny_admin(self, ident) -> str:
+        if self.auth is None:
+            return ""
+        if ident is None:
+            return "authentication required"
+        if not self.auth.is_admin(ident):
+            return "permission denied (admin required)"
+        return ""
+
+    def _deny_internal(self, ident) -> str:
+        """Craned-internal surface: the cluster secret or an admin."""
+        if self.auth is None:
+            return ""
+        from cranesched_tpu.ctld.auth import CRANED_IDENTITY
+        if ident == CRANED_IDENTITY or self.auth.is_admin(ident):
+            return ""
+        return "craned authentication required"
+
     # ---- handlers (each is unary-unary; the lock serializes) ----
+
+    def _check_submit_identity(self, ident, spec):
+        """The submit-side uid check (reference: the cert identity must
+        match the claimed uid): the spec's user must be the caller
+        unless the caller is an admin."""
+        if self.auth is None:
+            return ""
+        if ident is None:
+            return "authentication required"
+        if spec.user != ident and not self.auth.is_admin(ident):
+            return (f"permission denied (authenticated as {ident}, "
+                    f"spec claims {spec.user})")
+        return ""
 
     def SubmitBatchJob(self, request, context):
         try:
             spec = spec_from_pb(request.spec)
         except ValueError as exc:
             return pb.SubmitJobReply(job_id=0, error=str(exc))
+        deny = self._check_submit_identity(self._ident(context), spec)
+        if deny:
+            return pb.SubmitJobReply(job_id=0, error=deny)
         with self._lock:
             job_id = self.scheduler.submit(spec, now=self._now())
         return pb.SubmitJobReply(
@@ -86,6 +156,7 @@ class CtldServer:
 
     def SubmitBatchJobs(self, request, context):
         now = self._now()
+        ident = self._ident(context)
         replies = []
         with self._lock:
             for spec_pb in request.specs:
@@ -95,6 +166,11 @@ class CtldServer:
                     replies.append(pb.SubmitJobReply(job_id=0,
                                                      error=str(exc)))
                     continue
+                deny = self._check_submit_identity(ident, spec)
+                if deny:
+                    replies.append(pb.SubmitJobReply(job_id=0,
+                                                     error=deny))
+                    continue
                 job_id = self.scheduler.submit(spec, now=now)
                 replies.append(pb.SubmitJobReply(
                     job_id=job_id, error="" if job_id else "rejected"))
@@ -102,22 +178,38 @@ class CtldServer:
 
     def CancelJob(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.cancel(request.job_id, now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "no such job")
 
     def HoldJob(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.hold(request.job_id, request.held,
                                      now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "not pending")
 
     def SuspendJob(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.suspend(request.job_id, now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "not running")
 
     def ResumeJob(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.resume(request.job_id, now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "not suspended")
 
@@ -127,6 +219,10 @@ class CtldServer:
         except ValueError as exc:
             return pb.SubmitStepReply(step_id=-1, error=str(exc))
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.SubmitStepReply(step_id=-1, error=deny)
             step_id = self.scheduler.submit_step(request.job_id, spec,
                                                  now=self._now())
         return pb.SubmitStepReply(
@@ -135,6 +231,7 @@ class CtldServer:
                                           "allocation or bad share)")
 
     def QueryStepsInfo(self, request, context):
+        self._require_authenticated(self._ident(context), context)
         with self._lock:
             names = {i: n.name
                      for i, n in self.scheduler.meta.nodes.items()}
@@ -147,18 +244,27 @@ class CtldServer:
 
     def CancelStep(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.cancel_step(
                 request.job_id, request.step_id, now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "no such live step")
 
     def FreeAllocation(self, request, context):
         with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
             ok = self.scheduler.free_allocation(request.job_id,
                                                 now=self._now())
         return pb.OkReply(ok=ok,
                           error="" if ok else "not a running allocation")
 
     def QueryJobsInfo(self, request, context):
+        self._require_authenticated(self._ident(context), context)
         with self._lock:
             names = {i: n.name
                      for i, n in self.scheduler.meta.nodes.items()}
@@ -177,6 +283,7 @@ class CtldServer:
                 jobs=[job_to_pb(j, names) for j in jobs])
 
     def QueryClusterInfo(self, request, context):
+        self._require_authenticated(self._ident(context), context)
         from cranesched_tpu.ops.resources import (
             CPU_SCALE, DIM_CPU, DIM_MEM, MEM_UNIT_BYTES)
         with self._lock:
@@ -194,6 +301,9 @@ class CtldServer:
             return pb.QueryClusterReply(nodes=out)
 
     def CreateReservation(self, request, context):
+        deny = self._deny_admin(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             resv = self.scheduler.meta.create_reservation(
                 request.name, request.partition,
@@ -206,6 +316,9 @@ class CtldServer:
                           error="" if resv else "conflict")
 
     def DeleteReservation(self, request, context):
+        deny = self._deny_admin(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             ok = self.scheduler.meta.delete_reservation(request.name)
         return pb.OkReply(ok=ok, error="" if ok else "no such reservation")
@@ -214,6 +327,9 @@ class CtldServer:
         """Node control ops (reference control states
         PublicDefs.proto:98-106 + PowerStateChange,
         CtldGrpcServer.cpp:2583-2649)."""
+        deny = self._deny_admin(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             meta = self.scheduler.meta
             if request.name not in meta._name_to_id:
@@ -238,6 +354,7 @@ class CtldServer:
             return pb.OkReply(ok=True)
 
     def QueryStats(self, request, context):
+        self._require_authenticated(self._ident(context), context)
         import json as _json
         with self._lock:
             return pb.StatsReply(
@@ -259,7 +376,17 @@ class CtldServer:
                 else {}
         except _json.JSONDecodeError as exc:
             return pb.AcctMgrReply(ok=False, error=f"bad payload: {exc}")
-        actor = request.actor
+        if self.auth is not None:
+            # the actor is the AUTHENTICATED identity — never a request
+            # field (round-2 advisor: any client could claim
+            # actor="root" over the insecure port)
+            ident = self._ident(context)
+            if ident is None:
+                return pb.AcctMgrReply(ok=False,
+                                       error="authentication required")
+            actor = ident
+        else:
+            actor = request.actor
         try:
             with self._lock:
                 action = request.action
@@ -318,6 +445,9 @@ class CtldServer:
         """Health-check report (reference HealthCheck config,
         Craned.cpp:731-751): unhealthy nodes drain until they report
         healthy again."""
+        deny = self._deny_internal(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             node = self.scheduler.meta.nodes.get(request.node_id)
             if node is None:
@@ -330,16 +460,43 @@ class CtldServer:
                     ResReduceEvent(node.node_id))
             return pb.OkReply(ok=True)
 
+    def IssueToken(self, request, context):
+        """Admin-only token issuance (the SignUserCertificate analog)."""
+        if self.auth is None:
+            return pb.TokenReply(ok=False,
+                                 error="authentication is not enabled")
+        token = self.auth.issue(self._ident(context), request.user)
+        if token is None:
+            return pb.TokenReply(ok=False,
+                                 error="permission denied "
+                                       "(admin required)")
+        return pb.TokenReply(ok=True, token=token)
+
+    def RevokeToken(self, request, context):
+        if self.auth is None:
+            return pb.OkReply(ok=False,
+                              error="authentication is not enabled")
+        n = self.auth.revoke(self._ident(context), request.user)
+        if n < 0:
+            return pb.OkReply(ok=False, error="permission denied "
+                                              "(admin required)")
+        return pb.OkReply(ok=True)
+
     # ---- internal (node plane + virtual time) ----
 
     def CranedRegister(self, request, context):
+        deny = self._deny_internal(self._ident(context))
+        if deny:
+            return pb.CranedRegisterReply(ok=False, error=deny)
         with self._lock:
             meta = self.scheduler.meta
             if request.name in meta._name_to_id:
                 node = meta.node_by_name(request.name)
                 if node.power_state == "POWEREDOFF":
                     # refused until the operator wakes it (cnode wake)
-                    return pb.CranedRegisterReply(ok=False)
+                    return pb.CranedRegisterReply(
+                        ok=False, error="node is powered off "
+                                        "(wake it with cnode wake)")
             else:
                 # only GRES pairs in the cluster's configured layout can
                 # be represented; unknown pairs are ignored (the craned
@@ -383,6 +540,9 @@ class CtldServer:
                                           expected_jobs=expected)
 
     def CranedPing(self, request, context):
+        deny = self._deny_internal(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             node = self.scheduler.meta.nodes.get(request.node_id)
             if node is None:
@@ -396,6 +556,9 @@ class CtldServer:
             return pb.OkReply(ok=True)
 
     def StepStatusChange(self, request, context):
+        deny = self._deny_internal(self._ident(context))
+        if deny:
+            return pb.OkReply(ok=False, error=deny)
         with self._lock:
             if request.HasField("step_id"):
                 # step-level report (real craneds): routes through the
@@ -414,7 +577,11 @@ class CtldServer:
         return pb.OkReply(ok=True)
 
     def Tick(self, request, context):
-        """Run one virtual-time cycle (advance the sim plane first)."""
+        """Run one virtual-time cycle (advance the sim plane first).
+        Admin-gated under auth: it drives the cluster clock."""
+        deny = self._deny_admin(self._ident(context))
+        if deny:
+            return pb.TickReply(now=request.now, error=deny)
         with self._lock:
             if self.sim is not None:
                 self.sim.advance_to(request.now)
@@ -441,6 +608,8 @@ class CtldServer:
         "ModifyNode": (pb.ModifyNodeRequest, pb.OkReply),
         "QueryStats": (pb.StatsRequest, pb.StatsReply),
         "AcctMgr": (pb.AcctMgrRequest, pb.AcctMgrReply),
+        "IssueToken": (pb.IssueTokenRequest, pb.TokenReply),
+        "RevokeToken": (pb.IssueTokenRequest, pb.OkReply),
         "CranedHealth": (pb.CranedHealthRequest, pb.OkReply),
         "CranedRegister": (pb.CranedRegisterRequest,
                            pb.CranedRegisterReply),

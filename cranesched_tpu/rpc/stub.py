@@ -8,10 +8,14 @@ import grpc
 
 
 class GrpcStub:
-    def __init__(self, address: str, service: str, timeout: float = 30.0):
+    def __init__(self, address: str, service: str, timeout: float = 30.0,
+                 token: str = ""):
         self.address = address
         self.service = service
         self.timeout = timeout
+        # bearer token attached as metadata on every call (verified by
+        # the ctld's AuthManager; empty = unauthenticated)
+        self.token = token
         self._channel = grpc.insecure_channel(address)
         self._stubs = {}
 
@@ -23,7 +27,9 @@ class GrpcStub:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=reply_cls.FromString)
             self._stubs[name] = stub
-        return stub(request, timeout=self.timeout)
+        metadata = ((("crane-token", self.token),) if self.token
+                    else None)
+        return stub(request, timeout=self.timeout, metadata=metadata)
 
     def close(self) -> None:
         self._channel.close()

@@ -79,6 +79,10 @@ class CraneConfig:
     # accounting: RootUsers bootstrap the RBAC hierarchy; empty list =
     # accounting (and its limits) disabled — the open system
     accounting_root_users: list = dataclasses.field(default_factory=list)
+    # authentication (reference CheckCertAndUIDAllowed_ analog): token
+    # table path enables it; Admins are always-admin identities
+    auth_token_file: str = ""
+    auth_admins: list = dataclasses.field(default_factory=lambda: ["root"])
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -209,4 +213,8 @@ def load_config(path: str) -> CraneConfig:
         licenses=raw.get("Licenses", []) or [],
         submit_hook_path=str(raw.get("SubmitHook", "") or ""),
         accounting_root_users=list(
-            (raw.get("Accounting") or {}).get("RootUsers", [])))
+            (raw.get("Accounting") or {}).get("RootUsers", [])),
+        auth_token_file=str(
+            (raw.get("Auth") or {}).get("TokenFile", "") or ""),
+        auth_admins=[str(a) for a in
+                     (raw.get("Auth") or {}).get("Admins", ["root"])])

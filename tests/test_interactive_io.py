@@ -81,7 +81,8 @@ def test_interactive_crun_streams_without_shared_storage(plane):
     jid = sched.submit(JobSpec(
         res=ResourceSpec(cpu=1.0),
         script="echo to-stdout; echo to-stderr >&2; exit 4",
-        interactive_address=cfored.address), now=time.time())
+        interactive_address=cfored.address,
+        interactive_token=cfored.secret), now=time.time())
     sess = cfored.expect(jid, 0)
     outs, code = collect(sess)
     assert outs["out"] == b"to-stdout\n"
@@ -100,7 +101,8 @@ def test_stdin_roundtrip(plane):
     jid = sched.submit(JobSpec(
         res=ResourceSpec(cpu=1.0),
         script="while read line; do echo got:$line; done",
-        interactive_address=cfored.address), now=time.time())
+        interactive_address=cfored.address,
+        interactive_token=cfored.secret), now=time.time())
     sess = cfored.expect(jid, 0)
     sess.send_stdin(b"alpha\n")
     sess.send_stdin(b"beta\n")
@@ -120,7 +122,8 @@ def test_output_drained_before_exit_status(plane):
     jid = sched.submit(JobSpec(
         res=ResourceSpec(cpu=1.0),
         script=f"seq 1 {n}; exit 0",
-        interactive_address=cfored.address), now=time.time())
+        interactive_address=cfored.address,
+        interactive_token=cfored.secret), now=time.time())
     sess = cfored.expect(jid, 0)
     chunks = [data for _, data in sess.read(timeout=30.0)]
     text = b"".join(chunks)
@@ -148,7 +151,8 @@ def test_interactive_step_in_allocation_and_cancel(plane):
     sid = sched.submit_step(jid, StepSpec(
         res=ResourceSpec(cpu=1.0),
         script="echo started; sleep 60",
-        interactive_address=cfored.address), now=time.time())
+        interactive_address=cfored.address,
+        interactive_token=cfored.secret), now=time.time())
     sess = cfored.expect(jid, sid)
     # wait for the first output, then cancel — the Ctrl-C path
     got = next(iter(sess.read(timeout=20.0)))
@@ -184,3 +188,26 @@ def test_stream_session_watchdog_ends_wait_when_job_dies_unconnected():
         assert took < 10.0                    # bounded, not forever
     finally:
         cfored.stop()
+
+
+def test_stream_without_secret_is_rejected(plane):
+    """A stream that cannot present the hub secret must be refused —
+    otherwise any peer reaching the client's port could claim a session
+    (read the user's stdin, forge the exit status)."""
+    import grpc
+
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    from cranesched_tpu.rpc.consts import CFORED_SERVICE
+
+    sched, add_craned, cfored = plane
+    assert cfored.secret
+    channel = grpc.insecure_channel(cfored.address)
+    call = channel.stream_stream(
+        f"/{CFORED_SERVICE}/StepIO",
+        request_serializer=pb.StepIOChunk.SerializeToString,
+        response_deserializer=pb.StepIOChunk.FromString)(
+        iter([pb.StepIOChunk(job_id=1, step_id=0, token="wrong")]))
+    with pytest.raises(grpc.RpcError) as exc:
+        next(iter(call))
+    assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+    channel.close()
