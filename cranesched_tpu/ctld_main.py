@@ -54,6 +54,14 @@ def main(argv=None) -> int:
     cfg = load_config(args.config)
     meta, scheduler = cfg.build()
 
+    if cfg.archive_path:
+        from cranesched_tpu.ctld.archive import JobArchive
+        os.makedirs(os.path.dirname(cfg.archive_path) or ".",
+                    exist_ok=True)
+        scheduler.attach_archive(JobArchive(cfg.archive_path))
+        print(f"history archive: {cfg.archive_path} "
+              f"({scheduler.archive.count()} jobs)", flush=True)
+
     # recovery before serving (reference JobScheduler::Init)
     if cfg.wal_path:
         os.makedirs(os.path.dirname(cfg.wal_path) or ".", exist_ok=True)

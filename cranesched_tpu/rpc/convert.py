@@ -155,6 +155,13 @@ def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
     return msg
 
 
+def _node_name(node_names, n: int) -> str:
+    """Archived history can reference nodes that left the topology (or
+    a rebuilt cluster whose ids shifted) — render a placeholder, never
+    crash the query surface."""
+    return node_names.get(n, f"node#{n}")
+
+
 def step_to_pb(job_id: int, step: Step, node_names) -> pb.StepInfo:
     return pb.StepInfo(
         job_id=job_id,
@@ -165,7 +172,7 @@ def step_to_pb(job_id: int, step: Step, node_names) -> pb.StepInfo:
         submit_time=step.submit_time,
         start_time=step.start_time or 0.0,
         end_time=step.end_time or 0.0,
-        node_names=[node_names[n] for n in step.node_ids],
+        node_names=[_node_name(node_names, n) for n in step.node_ids],
     )
 
 
@@ -178,7 +185,7 @@ def job_to_pb(job: Job, node_names) -> pb.JobInfo:
         partition=job.spec.partition,
         status=job.status.value,
         pending_reason=job.pending_reason.value,
-        node_names=[node_names[n] for n in job.node_ids],
+        node_names=[_node_name(node_names, n) for n in job.node_ids],
         task_layout=job.task_layout,
         submit_time=job.submit_time,
         start_time=job.start_time or 0.0,

@@ -271,6 +271,19 @@ class CtldServer:
             jobs = list(self.scheduler.queue())
             if request.include_history:
                 jobs += list(self.scheduler.history.values())
+                if self.scheduler.archive is not None:
+                    # durable rows not in RAM (pre-restart /
+                    # post-compaction history); RAM wins on overlap.
+                    # Capped: a bare cacct on a long-lived cluster must
+                    # not deserialize the whole archive under the
+                    # server lock (newest rows are returned first)
+                    seen = {j.job_id for j in jobs}
+                    jobs += [j for j in self.scheduler.archive.query(
+                                 job_ids=list(request.job_ids),
+                                 user=request.user,
+                                 partition=request.partition,
+                                 limit=10_000)
+                             if j.job_id not in seen]
             if request.job_ids:
                 wanted = set(request.job_ids)
                 jobs = [j for j in jobs if j.job_id in wanted]

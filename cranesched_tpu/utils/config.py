@@ -66,6 +66,9 @@ class CraneConfig:
     cluster_name: str = "crane"
     listen: str = "127.0.0.1:50051"
     wal_path: str = ""
+    # durable history (sqlite; the reference's MongoDB role) — empty =
+    # RAM-only history that dies with the process
+    archive_path: str = ""
     nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
     partitions: list[PartitionConfig] = dataclasses.field(
         default_factory=list)
@@ -206,6 +209,7 @@ def load_config(path: str) -> CraneConfig:
         cluster_name=str(raw.get("ClusterName", "crane")),
         listen=str(raw.get("Listen", "127.0.0.1:50051")),
         wal_path=str(raw.get("Wal", "") or ""),
+        archive_path=str(raw.get("Archive", "") or ""),
         nodes=nodes,
         partitions=partitions,
         scheduler=raw.get("Scheduler", {}) or {},

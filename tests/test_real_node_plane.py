@@ -276,7 +276,8 @@ def test_gang_rendezvous_env_lets_members_enumerate_each_other(plane):
     jid = sched.submit(JobSpec(
         res=ResourceSpec(cpu=2.0), node_num=4,
         script=(f"echo $CRANE_NODE_RANK/$CRANE_NNODES"
-                f"@$CRANE_JOB_NODELIST@$CRANE_RENDEZVOUS >> {out}")),
+                f"@$CRANE_JOB_NODELIST@$CRANE_RENDEZVOUS"
+                f"@$CRANE_NODE_NAME >> {out}")),
         now=time.time())
     assert wait_for(
         lambda: sched.job_info(jid).status == JobStatus.COMPLETED,
@@ -284,17 +285,19 @@ def test_gang_rendezvous_env_lets_members_enumerate_each_other(plane):
     assert wait_for(lambda: out.exists()
                     and len(out.read_text().splitlines()) == 4)
     lines = sorted(out.read_text().splitlines())
-    ranks, nodelists, rdv = set(), set(), set()
+    ranks, nodelists, rdv = {}, set(), set()
     for line in lines:
-        rank_part, nodelist, endpoint = line.split("@")
+        rank_part, nodelist, endpoint, node_name = line.split("@")
         rank, nnodes = rank_part.split("/")
         assert nnodes == "4"
-        ranks.add(int(rank))
+        ranks[int(rank)] = node_name
         nodelists.add(nodelist)
         rdv.add(endpoint)
-    assert ranks == {0, 1, 2, 3}          # each member a distinct rank
+    assert set(ranks) == {0, 1, 2, 3}     # each member a distinct rank
     assert len(nodelists) == 1            # same gang view everywhere
     assert nodelists == {"gv[00-03]"}     # compressed hostlist
     assert len(rdv) == 1                  # one shared coordinator
     host, port = rdv.pop().split(":")
-    assert host == "gv00" and port.isdigit()
+    # the coordinator IS the rank-0 member (whichever node that is —
+    # placement orders the gang by cost, not by name)
+    assert host == ranks[0] and port.isdigit()

