@@ -1363,67 +1363,156 @@ class JobScheduler:
     # running jobs; victims ordered lowest-qos-first then youngest-first)
     # ------------------------------------------------------------------
 
+    def _preemptor_req(self, job: Job) -> tuple[np.ndarray, list[int]]:
+        """Per-node requirement a preemptor needs freed, plus its task
+        layout.  Packed jobs use the balanced layout's MAX per-node
+        requirement in the what-if (the commit distributes floor tasks
+        to later nodes, which can only use less)."""
+        spec = job.spec
+        base = spec.res.encode(self.meta.layout).astype(np.int64)
+        ntasks = spec.ntasks if spec.ntasks is not None else \
+            spec.node_num
+        # balanced layout ALWAYS (for ntasks == node_num it is all
+        # ones): an empty layout would make the dispatcher fall back to
+        # one task per node and launch half the gang
+        hi = int(np.ceil(ntasks / spec.node_num))
+        lo = ntasks // spec.node_num
+        n_hi = ntasks - lo * spec.node_num
+        layout = [hi] * n_hi + [lo] * (spec.node_num - n_hi)
+        if spec.task_res is None:
+            return base, layout
+        task = spec.task_res.encode(self.meta.layout).astype(np.int64)
+        return base + task * hi, layout
+
     def _try_preemption(self, ordered: list[Job], now: float) -> list[int]:
+        """Device-side what-if (models/preempt.solve_preempt — the
+        prefix-sum formulation of the reference's PreemptSegTree) +
+        host-authoritative commit.  Runs after the normal solve, so a
+        job that got only a future-start backfill reservation can still
+        preempt its way to an immediate start (the reference's ordering:
+        TryPreempt_ before Backfill_, cpp:6369-6378)."""
         if self.config.preempt_mode == "off" or self.accounts is None:
             return []
-        started = []
+        # blocked preemptor candidates, in priority order
+        cands = []
+        prey_sets = []
         for job in ordered:
             if job.job_id not in self.pending:
                 continue  # it placed normally
             if job.pending_reason not in (PendingReason.RESOURCE,
                                           PendingReason.PRIORITY):
                 continue
-            if job.spec.task_res is not None or job.spec.exclusive:
-                continue  # packed/exclusive preemption not supported
             qos = self.accounts.qos.get(job.qos_name)
             if qos is None or not qos.preempt:
                 continue
-            if self._preempt_for(job, qos.preempt, now):
+            cands.append(job)
+            prey_sets.append(qos.preempt)
+        if not cands:
+            return []
+        # victim pool: only jobs SOME candidate may actually prey on —
+        # the kernel builds [M, N, R] tensors per scan step, so the
+        # pool must be bounded by preemptable jobs, not the whole
+        # running set.  Sorted ONCE by the reference order (lowest qos
+        # first, youngest first); the global sort induces the same
+        # per-node prefix order the segment-tree walk used.
+        prey_union = set().union(*prey_sets)
+        victims = sorted(
+            (j for j in self.running.values()
+             if j.qos_name in prey_union),
+            key=lambda v: (v.qos_priority, -(v.start_time or 0.0)))
+        if not victims:
+            return []
+
+        from cranesched_tpu.models.preempt import (
+            PreemptorBatch, VictimRows, solve_preempt)
+
+        lay = self.meta.layout
+        avail, total, alive = self.meta.snapshot()
+        N = total.shape[0]
+        # flat (victim, node) rows, padded to a bucketed size
+        rows = [(vi, n, alloc) for vi, v in enumerate(victims)
+                for n, alloc in zip(v.node_ids, self._job_alloc(v))]
+        M = self._bucket(len(rows))
+        V = self._bucket(len(victims))
+        r_vid = np.zeros(M, np.int32)
+        r_node = np.full(M, -1, np.int32)
+        r_alloc = np.zeros((M, lay.num_dims), np.int32)
+        r_valid = np.zeros(M, bool)
+        for i, (vi, n, alloc) in enumerate(rows):
+            r_vid[i], r_node[i], r_alloc[i] = vi, n, alloc
+            r_valid[i] = True
+
+        J = self._bucket(len(cands))
+        req = np.zeros((J, lay.num_dims), np.int64)
+        node_num = np.zeros(J, np.int32)
+        time_limit = np.zeros(J, np.int32)
+        part_mask = np.zeros((J, N), bool)
+        exclusive = np.zeros(J, bool)
+        can_prey = np.zeros((J, V), bool)
+        valid = np.zeros(J, bool)
+        layouts = []
+        for i, (job, prey) in enumerate(zip(cands, prey_sets)):
+            jr, layout = self._preemptor_req(job)
+            layouts.append(layout)
+            req[i] = jr
+            node_num[i] = job.spec.node_num
+            time_limit[i] = job.spec.time_limit
+            part_mask[i] = self._mask_for(job, now)
+            exclusive[i] = job.spec.exclusive
+            valid[i] = True
+            for vi, v in enumerate(victims):
+                can_prey[i, vi] = v.qos_name in prey
+        max_nodes = self._bucket(
+            max(1, min(int(node_num.max(initial=1)),
+                       self.config.max_nodes_per_job)), floor=1)
+
+        batch = PreemptorBatch(
+            req=jnp.asarray(req, jnp.int32),
+            node_num=jnp.asarray(node_num),
+            time_limit=jnp.asarray(time_limit),
+            part_mask=jnp.asarray(part_mask),
+            exclusive=jnp.asarray(exclusive),
+            can_prey=jnp.asarray(can_prey),
+            valid=jnp.asarray(valid))
+        vrows = VictimRows(vid=jnp.asarray(r_vid),
+                           node=jnp.asarray(r_node),
+                           alloc=jnp.asarray(r_alloc),
+                           valid=jnp.asarray(r_valid))
+        decisions, _ = solve_preempt(
+            avail, total, alive, self._ledger.cost0(now, N),
+            vrows, batch, num_victims=V, max_nodes=max_nodes)
+
+        placed = np.asarray(decisions.placed)
+        nodes_mat = np.asarray(decisions.nodes)
+        evict_mat = np.asarray(decisions.evict)
+        started: list[int] = []
+        for i, job in enumerate(cands):
+            if not placed[i]:
+                continue
+            chosen = [int(n) for n in nodes_mat[i] if n >= 0]
+            evict_ids = [victims[vi].job_id
+                         for vi in np.nonzero(evict_mat[i])[0]
+                         if vi < len(victims)]
+            if self._commit_preemption(job, chosen, evict_ids,
+                                       layouts[i], now):
                 started.append(job.job_id)
+            else:
+                # the device sequenced later candidates assuming this
+                # one placed; their decisions are now stale — stop here
+                # (they retry next cycle against fresh state) rather
+                # than kill victims for placements that cannot commit
+                break
         return started
 
-    def _preempt_for(self, job: Job, preempt_qos: set[str],
-                     now: float) -> bool:
-        req = job.spec.res.encode(self.meta.layout)
-        mask = self._mask_for(job, now)
-        # nodes where evicting preemptable jobs would free enough
-        chosen: list[int] = []
-        victims: set[int] = set()
-        for node in self.meta.nodes.values():
-            if len(chosen) == job.spec.node_num:
-                break
-            if not node.schedulable or not mask[node.node_id]:
-                continue
-            node_victims = [
-                self.running[j] for j in node.running_jobs
-                if j in self.running
-                and self.running[j].qos_name in preempt_qos]
-            potential = node.avail.astype(np.int64).copy()
-            for v in node_victims:
-                idx = v.node_ids.index(node.node_id)
-                potential += self._job_alloc(v)[idx]
-            if not (req <= potential).all():
-                continue
-            # evict as few as possible: lowest qos priority first, then
-            # youngest first (latest start) — reference victim order
-            node_victims.sort(key=lambda v: (v.qos_priority,
-                                             -(v.start_time or 0.0)))
-            avail = node.avail.astype(np.int64).copy()
-            node_evict = []
-            for v in node_victims:
-                if (req <= avail).all():
-                    break
-                idx = v.node_ids.index(node.node_id)
-                avail += self._job_alloc(v)[idx]
-                node_evict.append(v.job_id)
-            if (req <= avail).all():
-                chosen.append(node.node_id)
-                victims.update(node_evict)
+    def _commit_preemption(self, job: Job, chosen: list[int],
+                           evict_ids: list[int], layout: list[int],
+                           now: float) -> bool:
+        """Host-authoritative commit of one device preemption decision:
+        admission checks BEFORE any eviction (victims must never die for
+        a preemptor that cannot start), then evict, then malloc with
+        mid-cycle revalidation."""
         if len(chosen) < job.spec.node_num:
             return False
-
-        # node-independent admission checks come BEFORE any eviction so
-        # victims are never killed for a preemptor that cannot start
         if job.spec.licenses and not self.licenses.malloc(
                 job.spec.licenses):
             job.pending_reason = PendingReason.LICENSE
@@ -1433,10 +1522,10 @@ class JobScheduler:
             job.pending_reason = PendingReason.QOS_LIMIT
             return False
 
-        for victim_id in victims:
+        for victim_id in evict_ids:
             self._evict(victim_id, now)
         job.node_ids = chosen
-        job.task_layout = []
+        job.task_layout = list(layout)
         job.alloc_cache = None
         if not self.meta.malloc_resource(job.job_id, chosen,
                                          self._job_alloc(job)):
@@ -1444,6 +1533,9 @@ class JobScheduler:
             self.licenses.free(job.spec.licenses or {})
             self._free_run_limits(job)
             job.node_ids = []
+            job.task_layout = []
+            job.alloc_cache = None
+            job.pending_reason = PendingReason.RESOURCE
             return False
         del self.pending[job.job_id]
         job.status = JobStatus.RUNNING

@@ -1,0 +1,219 @@
+"""QoS preemption as a device-side what-if solve.
+
+The reference's TryPreempt_ asks, for each blocked job in priority
+order: which minimal set of lower-QoS running jobs must die for this
+job to start now?  It answers with a lazy segment tree over time per
+node (PreemptSegTree, reference: src/CraneCtld/JobScheduler.h:867-980,
+cpp:6378-6505) and victim order lowest-QoS-first then youngest-first.
+
+TPU-native formulation (no tree, no pointer surgery):
+
+* Victim state is a flat SoA of (victim, node) allocation rows, sorted
+  host-side ONCE by (qos_priority asc, start_time desc) — a global sort
+  induces the reference's per-node victim order.
+* For one preemptor and one node, the minimal victim prefix is a
+  PREFIX-SUM question: take on-node victims in order while the job
+  still does not fit — victim i is selected iff
+  ``any(req > avail + cumsum_{j<i, on node}(alloc_j))``.  The
+  segment-tree "what-if add" collapses to an exclusive cumulative sum
+  because the what-if is evaluated at t = now (the preemptor starts
+  immediately; its future window is cleared by the evictions
+  themselves).
+* Feasibility per node: ``all(req <= avail + preemptable_sum)``; an
+  EXCLUSIVE preemptor additionally needs the whole node:
+  ``avail + preemptable_sum == total`` in every dimension.
+* Sequentiality is inherent (victims consumed by one preemptor are
+  gone for the next; a multi-node victim frees on ALL its nodes), so
+  jobs run in a lax.scan whose carry is (avail, cost, victim_alive);
+  each step is vectorized over all rows/nodes.
+
+The host commits the result exactly like a normal placement (licenses,
+run limits, ledger malloc with mid-cycle revalidation) and performs the
+actual evictions — the solve only *decides*.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    cheapest_k,
+    quantized_dcost,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+
+@struct.dataclass
+class VictimRows:
+    """Flat (victim, node) allocation rows, pre-sorted by the victim
+    order (qos asc, start desc).  ``vid`` groups rows of one victim so
+    evicting it frees every row.
+
+    vid:    int32[M]   victim index in [0, V)
+    node:   int32[M]   node the row's allocation lives on
+    alloc:  int32[M,R] the per-node allocation
+    valid:  bool[M]    padding mask
+    """
+
+    vid: jax.Array
+    node: jax.Array
+    alloc: jax.Array
+    valid: jax.Array
+
+
+@struct.dataclass
+class PreemptorBatch:
+    """Blocked jobs whose QoS may preempt, in priority order.
+
+    req:        int32[J,R] per-node requirement (packed jobs: their
+                balanced layout's max per-node requirement)
+    node_num:   int32[J]
+    time_limit: int32[J]
+    part_mask:  bool[J,N]
+    exclusive:  bool[J]    whole idle-after-eviction nodes only
+    can_prey:   bool[J,V]  preemptor j may evict victim v (QoS listed in
+                the preemptor's preempt set)
+    valid:      bool[J]
+    """
+
+    req: jax.Array
+    node_num: jax.Array
+    time_limit: jax.Array
+    part_mask: jax.Array
+    exclusive: jax.Array
+    can_prey: jax.Array
+    valid: jax.Array
+
+
+@struct.dataclass
+class PreemptDecisions:
+    """placed[J]; nodes[J,K] chosen nodes (-1 pad); evict[J,V] victims
+    this job kills."""
+
+    placed: jax.Array
+    nodes: jax.Array
+    evict: jax.Array
+
+
+def _whatif_one(avail, cost, total, alive, victim_alive, rows: VictimRows,
+                req, node_num, part_mask, exclusive, can_prey, valid,
+                max_nodes: int, num_victims: int):
+    n, r = avail.shape
+    m = rows.vid.shape[0]
+
+    # rows usable by THIS preemptor: alive victim + allowed QoS
+    row_on = (rows.valid & victim_alive[rows.vid]
+              & can_prey[rows.vid])                                # [M]
+    row_alloc = jnp.where(row_on[:, None], rows.alloc, 0)          # [M,R]
+
+    # per-node preemptable sum and potential availability
+    pre_sum = jnp.zeros((n, r), jnp.int32).at[rows.node].add(
+        row_alloc, mode="drop")
+    potential = avail + pre_sum
+    eligible = alive & part_mask
+    fits = jnp.all(req[None, :] <= potential, axis=-1)
+    whole = jnp.all(potential == total, axis=-1)
+    feasible = eligible & fits & jnp.where(exclusive, whole, True)
+
+    # cheapest node_num feasible nodes (same cost order as placement)
+    masked_cost = jnp.where(feasible, cost, COST_INF)
+    sel_cost, idx = cheapest_k(masked_cost, max_nodes)
+    k_mask = jnp.arange(max_nodes) < node_num
+    enough = jnp.sum(feasible, dtype=jnp.int32) >= node_num
+    ok = valid & (node_num > 0) & (node_num <= max_nodes) & enough
+    sel = ok & k_mask & (sel_cost < COST_INF)                      # [K]
+
+    # minimal victim prefix per chosen node: exclusive cumsum of on-node
+    # rows in the global (pre-sorted) order
+    is_chosen = jnp.zeros(n + 1, bool).at[
+        jnp.where(sel, idx, n)].set(True, mode="drop")[:n]         # [N]
+    row_chosen = row_on & is_chosen[jnp.clip(rows.node, 0, n - 1)]  # [M]
+    # per-node EXCLUSIVE cumsum: for row i on node b, the resources
+    # freed by earlier selected rows on b.  One-hot node masks give
+    # [M,N,R] tensors — fine for the preemption pool sizes this
+    # targets (victims, not the whole cluster; the caller pre-filters
+    # the pool to actually-preemptable jobs).
+    node_onehot = (rows.node[:, None] ==
+                   jnp.arange(n, dtype=jnp.int32)[None, :])        # [M,N]
+    contrib = jnp.where(row_chosen[:, None, None],
+                        node_onehot[:, :, None] *
+                        rows.alloc[:, None, :], 0)                 # [M,N,R]
+    cum_excl = jnp.cumsum(contrib, axis=0) - contrib               # [M,N,R]
+    # row's own node's exclusive sum:
+    own_excl = jnp.take_along_axis(
+        cum_excl, jnp.clip(rows.node, 0, n - 1)[:, None, None]
+        .repeat(r, axis=2), axis=1)[:, 0, :]                       # [M,R]
+    avail_at_row = avail[jnp.clip(rows.node, 0, n - 1)] + own_excl
+    still_short = jnp.any(req[None, :] > avail_at_row, axis=-1)    # [M]
+    # an EXCLUSIVE preemptor needs the whole node: every preemptable
+    # victim on a chosen node dies regardless of whether req already
+    # fits (the minimal-prefix rule applies only to shared placements)
+    evict_row = row_chosen & (still_short | exclusive)             # [M]
+
+    # victims evicted (any row evicted kills the whole victim — it
+    # frees on every node it occupies)
+    evict_v = jnp.zeros(num_victims, bool).at[rows.vid].max(
+        evict_row, mode="drop")
+    evict_v = evict_v & ok
+
+    # apply the evictions: free every row of evicted victims (a victim
+    # dies everywhere it runs).  The preemptor's own allocation + cost
+    # update happen in the scan step (apply_placement needs time_limit).
+    row_freed = evict_v[rows.vid] & rows.valid                     # [M]
+    avail = avail.at[rows.node].add(
+        jnp.where(row_freed[:, None], rows.alloc, 0), mode="drop")
+    return avail, cost, ok, sel, idx, evict_v, victim_alive & ~evict_v
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_nodes", "num_victims"))
+def solve_preempt(avail, total, alive, cost, rows: VictimRows,
+                  jobs: PreemptorBatch, num_victims: int,
+                  max_nodes: int = 1
+                  ) -> tuple[PreemptDecisions, jax.Array]:
+    """Greedy what-if in priority order; returns decisions + final
+    victim_alive mask."""
+    n = avail.shape[0]
+    max_nodes = min(max_nodes, n)
+    avail = jnp.asarray(avail, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    cost = jnp.asarray(cost, jnp.int32)
+
+    def step(carry, job):
+        av, c, v_alive = carry
+        req, nn, tl, pm, ex, prey, v = job
+        av2, c2, ok, sel, idx, evict_v, v_alive2 = _whatif_one(
+            av, c, total, alive, v_alive, rows, req, nn, pm, ex, prey,
+            v, max_nodes, num_victims)
+        # the preemptor's own allocation + cost update.  An EXCLUSIVE
+        # preemptor occupies the WHOLE node (the host commit charges
+        # node totals via _job_alloc) — charging only req here would
+        # let later candidates in the same batch place on capacity that
+        # does not exist on the host, killing their victims for nothing.
+        safe = jnp.clip(idx, 0, n - 1)
+        eff_req = jnp.where(ex, total[safe],
+                            jnp.broadcast_to(req, (idx.shape[0],
+                                                   req.shape[0])))
+        scatter = jnp.where(sel, idx, n)
+        delta = jnp.where(sel[:, None], eff_req, 0)
+        av3 = av2.at[scatter].add(-delta, mode="drop")
+        cpu_total = jnp.maximum(total[:, DIM_CPU], 1).astype(
+            jnp.float32)
+        dcost = quantized_dcost(tl, eff_req[:, DIM_CPU],
+                                cpu_total[safe])
+        c3 = c2.at[scatter].add(jnp.where(sel, dcost, 0), mode="drop")
+        chosen = jnp.where(sel, idx, -1)
+        return (av3, c3, v_alive2), (ok, chosen, evict_v)
+
+    init = (avail, cost, jnp.ones(num_victims, bool))
+    (av, c, v_alive), (placed, nodes, evict) = jax.lax.scan(
+        step, init,
+        (jobs.req, jobs.node_num, jobs.time_limit, jobs.part_mask,
+         jobs.exclusive, jobs.can_prey, jobs.valid))
+    return PreemptDecisions(placed=placed, nodes=nodes,
+                            evict=evict), v_alive
