@@ -81,7 +81,8 @@ class CranedDaemon:
                  health_program: str = "",
                  health_interval: float = 30.0,
                  gres: dict | None = None,
-                 token: str = ""):
+                 token: str = "",
+                 prolog: str = "", epilog: str = ""):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -94,6 +95,13 @@ class CranedDaemon:
         self.health_program = health_program
         self.health_interval = health_interval
         self.healthy = True
+        # task prolog/epilog scripts run by the supervisor around every
+        # step (reference config.yaml:121-133); a failing prolog fails
+        # the step AND drains this node (policy: a broken node setup
+        # must not eat the queue job by job); a failing epilog only
+        # drains
+        self.prolog = prolog
+        self.epilog = epilog
         # GRES slot identity (reference DeviceManager, DeviceManager.h:
         # 26-80: concrete slot ids assigned at step start, vendor env
         # injection).  Slot ids live in a node-global index space per
@@ -422,6 +430,7 @@ class CranedDaemon:
             time_limit=time_limit,
             env=step_env,
             cfored=cfored, cfored_token=cfored_token, pty=use_pty,
+            prolog=self.prolog, epilog=self.epilog,
             cgroup_procs=alloc.procs_path)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
@@ -534,15 +543,38 @@ class CranedDaemon:
             # ones wait for FreeJob (their GRES/cgroup belong to the
             # allocation, not the step)
             self._maybe_teardown_alloc(step.job_id)
+        # lifecycle-hook outcomes ride the report line: a failing
+        # epilog is a suffix (job outcome unchanged, node drains); a
+        # failing prolog is its own report (step failed before the user
+        # command ran, node drains)
+        hook_drain = ""
+        if report.endswith(" EPILOGFAIL"):
+            report = report[: -len(" EPILOGFAIL")]
+            hook_drain = "epilog failed"
         if step.cancelled or report == "KILLED":
             status, code = "Cancelled", 130
         elif report == "TIMEOUT":
             status, code = "ExceedTimeLimit", 124
+        elif report.startswith("PROLOGFAIL"):
+            status, code = "Failed", 222
+            hook_drain = "prolog failed"
         elif report.startswith("EXIT "):
             code = int(report.split()[1])
             status = "Completed" if code == 0 else "Failed"
         else:  # supervisor died without a report
             status, code = "Failed", 255
+        if hook_drain and self.node_id is not None:
+            # drain policy: report unhealthy so ctld stops placing work
+            # here until the operator fixes the hook and RESUMES (cnode
+            # resume clears it).  self.healthy is deliberately NOT
+            # touched: the periodic health program's state machine only
+            # reports on its OWN transitions, so a passing probe cannot
+            # auto-undrain a hook-failure drain.
+            try:
+                self._ctld.craned_health(self.node_id, False,
+                                         hook_drain)
+            except (grpc.RpcError, ValueError):
+                pass
         try:
             self._ctld.step_status_change(step.job_id, status, code,
                                           time.time(),

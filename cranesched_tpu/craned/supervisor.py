@@ -36,6 +36,17 @@ def _substitute(pattern: str, job_id: int) -> str:
     return pattern.replace("%j", str(job_id))
 
 
+def _run_hook(script: str, env: dict, out_fh=None) -> int:
+    """Run a prolog/epilog script with SAFE fds: stdin closed and
+    stdout/stderr to the step's output file (or devnull) — NEVER the
+    supervisor's own stdout/stdin, which are the one-line report pipe
+    and the control-verb pipe (a chatty hook would corrupt both)."""
+    sink = out_fh if out_fh is not None else subprocess.DEVNULL
+    return subprocess.run(["bash", "-c", script], env=env,
+                          stdin=subprocess.DEVNULL, stdout=sink,
+                          stderr=sink).returncode
+
+
 class _InteractiveIO:
     """Streams the child's stdout/stderr to the client's embedded
     CraneFored service and feeds stdin back (the reference's
@@ -209,14 +220,29 @@ def main() -> int:
     if go != "GO":
         return 1
 
-    if interactive is not None:
-        child = interactive.spawn(script, env)
-    else:
+    out = None
+    if interactive is None:
         out_path = _substitute(init.get("output_path") or "/dev/null",
                                job_id)
         if out_path != "/dev/null":
             os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
         out = open(out_path, "ab", buffering=0)
+
+    # task prolog (reference RunPrologOrEpiLog + config.yaml:121-133):
+    # runs with the step's env BEFORE the user command; a failing
+    # prolog fails the step with a distinguishable report so the craned
+    # can apply the drain policy (a broken node setup must not eat the
+    # whole queue job by job)
+    prolog = init.get("prolog") or ""
+    if prolog:
+        rc = _run_hook(prolog, env, out)
+        if rc != 0:
+            print(f"PROLOGFAIL {rc}", flush=True)
+            return 0
+
+    if interactive is not None:
+        child = interactive.spawn(script, env)
+    else:
         child = subprocess.Popen(
             ["bash", "-c", script], stdout=out, stderr=out, env=env,
             start_new_session=True)
@@ -277,17 +303,31 @@ def main() -> int:
             child.wait()
             if interactive is not None:
                 interactive.finish(124)
-            print("TIMEOUT", flush=True)
+            suffix = ""
+            if init.get("epilog"):
+                if _run_hook(init["epilog"], env, out) != 0:
+                    suffix = " EPILOGFAIL"
+            print("TIMEOUT" + suffix, flush=True)
             return 0
 
     if interactive is not None:
         # readers drained + exited chunk sent BEFORE the craned report:
         # the client always has the full output when the exit lands
         interactive.finish(130 if state["terminated"] else code)
+
+    # task epilog: always runs once the user command ended (killed or
+    # not); its failure never changes the job's outcome but is reported
+    # so the craned can drain the node (reference epilog policy)
+    epilog_suffix = ""
+    epilog = init.get("epilog") or ""
+    if epilog:
+        if _run_hook(epilog, env, out) != 0:
+            epilog_suffix = " EPILOGFAIL"
+
     if state["terminated"]:
-        print("KILLED", flush=True)
+        print("KILLED" + epilog_suffix, flush=True)
     else:
-        print(f"EXIT {code}", flush=True)
+        print(f"EXIT {code}{epilog_suffix}", flush=True)
     return 0
 
 

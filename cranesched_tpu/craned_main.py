@@ -33,6 +33,13 @@ def main(argv=None) -> int:
                          "(the @craned entry in the token table)")
     ap.add_argument("--token-file", default="",
                     help="read the cluster secret's token from a file")
+    ap.add_argument("--prolog", default="",
+                    help="task prolog script (bash -c) run before "
+                         "every step; failure fails the step and "
+                         "drains this node")
+    ap.add_argument("--epilog", default="",
+                    help="task epilog script run after every step; "
+                         "failure drains this node")
     args = ap.parse_args(argv)
 
     token = args.token
@@ -56,7 +63,8 @@ def main(argv=None) -> int:
         cgroup_root=args.cgroup_root,
         health_program=args.health_program,
         health_interval=args.health_interval,
-        gres=gres, token=token)
+        gres=gres, token=token,
+        prolog=args.prolog, epilog=args.epilog)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

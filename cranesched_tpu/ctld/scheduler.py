@@ -192,8 +192,14 @@ class JobScheduler:
         # seed + backfill release rows come from O(rows) numpy instead
         # of an O(running) Python loop every cycle (VERDICT r2 weak #4)
         self._ledger = RunLedger(meta.layout.num_dims)
-        if archive is not None:
-            self.attach_archive(archive)
+        # node lifecycle event seam (reference NodeEventHook,
+        # Plugin.proto:75-95 — the plugin daemon's node-event surface):
+        # callable(event_dict) fired on up/down/drain/undrain/power
+        # transitions, async (never under the RPC lock's critical
+        # path); plus a bounded in-RAM event log for observability
+        self.node_event_hook = None
+        self.node_events: list[dict] = []
+        self._node_event_queue = None  # lazily-started ordered worker
         # observability (reference per-phase wall-clock trace,
         # JobScheduler.cpp:1444-1447,1723-1903)
         self.stats = {
@@ -201,6 +207,46 @@ class JobScheduler:
             "jobs_submitted_total": 0, "jobs_finished_total": 0,
             "last_cycle": {},
         }
+        if archive is not None:
+            self.attach_archive(archive)
+
+    def emit_node_event(self, event: str, node_name: str,
+                        detail: str = "", now: float = 0.0) -> None:
+        """Record + fan out one node lifecycle event.  The hook runs on
+        ONE worker thread draining a queue — operator code never blocks
+        a cycle, and back-to-back transitions (drain then undrain)
+        reach the hook in ORDER, never concurrently (a per-event thread
+        would let the undrain overtake the drain and leave the
+        operator's external system with the wrong final state)."""
+        record = {"event": event, "node": node_name, "detail": detail,
+                  "time": now}
+        self.node_events.append(record)
+        if len(self.node_events) > 200:
+            del self.node_events[: len(self.node_events) - 200]
+        if self.node_event_hook is None:
+            return
+        if self._node_event_queue is None:
+            import queue
+            import threading
+            self._node_event_queue = queue.Queue()
+
+            def worker():
+                while True:
+                    rec = self._node_event_queue.get()
+                    hook = self.node_event_hook
+                    if hook is None:
+                        continue
+                    try:
+                        hook(rec)
+                    except Exception:
+                        import logging
+                        import traceback
+                        logging.getLogger("cranesched.ctld").error(
+                            "node event hook raised:\n%s",
+                            traceback.format_exc())
+
+            threading.Thread(target=worker, daemon=True).start()
+        self._node_event_queue.put(record)
 
     # history the RAM dict may hold with an archive attached (the
     # durable store serves the rest; without an archive RAM is the only
@@ -1023,6 +1069,10 @@ class JobScheduler:
     def on_craned_down(self, node_id: int, now: float) -> list[int]:
         """Node died: terminate its jobs; system-failure auto-requeue up
         to MaxRequeueCount, then held (CtldPublicDefs.h:101-102)."""
+        node = self.meta.nodes.get(node_id)
+        self.emit_node_event("node_down",
+                             node.name if node else str(node_id),
+                             now=now)
         victim_ids = self.meta.craned_down(node_id)
         for job_id in victim_ids:
             job = self.running.get(job_id)

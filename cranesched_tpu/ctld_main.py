@@ -85,6 +85,12 @@ def main(argv=None) -> int:
         dispatcher = GrpcDispatcher(scheduler)
         dispatcher.wire(scheduler)
 
+    if cfg.node_event_hook_path:
+        from cranesched_tpu.utils.config import (
+            make_node_event_script_hook)
+        scheduler.node_event_hook = make_node_event_script_hook(
+            cfg.node_event_hook_path)
+
     auth = None
     if cfg.auth_token_file:
         from cranesched_tpu.ctld.auth import AuthManager

@@ -351,13 +351,29 @@ class CtldServer:
             action = request.action.lower()
             if action == "drain":
                 meta.drain(node.node_id, True)
+                self.scheduler.emit_node_event("drain", node.name,
+                                               "operator",
+                                               now=self._now())
             elif action == "resume":
                 meta.drain(node.node_id, False)
+                # the operator's resume is the recovery path for hook-
+                # failure drains too (they ride the health flag and
+                # nothing else would ever clear them without a
+                # configured health program)
+                node.health_drained = False
+                node.health_message = ""
+                self.scheduler.emit_node_event("undrain", node.name,
+                                               "operator",
+                                               now=self._now())
             elif action == "poweroff":
                 node.power_state = "POWEREDOFF"
+                self.scheduler.emit_node_event("poweroff", node.name,
+                                               now=self._now())
                 self.scheduler.on_craned_down(node.node_id, self._now())
             elif action == "wake":
                 node.power_state = "ACTIVE"
+                self.scheduler.emit_node_event("wake", node.name,
+                                               now=self._now())
                 if not node.expect_pings:
                     node.alive = True  # sim nodes wake immediately;
                                        # real ones wake at re-register
@@ -465,12 +481,18 @@ class CtldServer:
             node = self.scheduler.meta.nodes.get(request.node_id)
             if node is None:
                 return pb.OkReply(ok=False, error="unknown node")
+            was_drained = node.health_drained
             node.health_message = request.message
             node.health_drained = not request.healthy
             if not request.healthy:
                 from cranesched_tpu.ctld.meta import ResReduceEvent
                 self.scheduler.meta._log_event(
                     ResReduceEvent(node.node_id))
+            if was_drained != node.health_drained:
+                self.scheduler.emit_node_event(
+                    "drain" if node.health_drained else "undrain",
+                    node.name, f"health: {request.message}",
+                    now=self._now())
             return pb.OkReply(ok=True)
 
     def IssueToken(self, request, context):
@@ -529,7 +551,11 @@ class CtldServer:
                         gres=gres,
                         is_capacity=True),
                     partitions=tuple(request.partitions) or ("default",))
+            was_alive = node.alive
             meta.craned_up(node.node_id)
+            if not was_alive:
+                self.scheduler.emit_node_event("node_up", node.name,
+                                               now=self._now())
             if request.address:
                 # a REAL craned: remember its push address and expect
                 # pings (missed pings -> CranedDown in the cycle)

@@ -86,6 +86,10 @@ class CraneConfig:
     # table path enables it; Admins are always-admin identities
     auth_token_file: str = ""
     auth_admins: list = dataclasses.field(default_factory=lambda: ["root"])
+    # node lifecycle event hook script (reference NodeEventHook,
+    # Plugin.proto:75-95): run with CRANE_EVENT/CRANE_NODE/... env on
+    # up/down/drain/undrain/power transitions
+    node_event_hook_path: str = ""
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -178,6 +182,26 @@ def load_submit_hook(path: str):
     return hook
 
 
+def make_node_event_script_hook(script: str):
+    """Wrap an operator script as a node-event callable: one invocation
+    per event with CRANE_EVENT / CRANE_NODE / CRANE_DETAIL /
+    CRANE_EVENT_TIME in the env (the shell analog of the reference's
+    NodeEventHook plugin RPC)."""
+    import os
+    import subprocess
+
+    def hook(event: dict) -> None:
+        env = dict(os.environ,
+                   CRANE_EVENT=str(event.get("event", "")),
+                   CRANE_NODE=str(event.get("node", "")),
+                   CRANE_DETAIL=str(event.get("detail", "")),
+                   CRANE_EVENT_TIME=str(event.get("time", "")))
+        subprocess.run(["bash", "-c", script], env=env, timeout=60,
+                       capture_output=True)
+
+    return hook
+
+
 def load_config(path: str) -> CraneConfig:
     with open(path, encoding="utf-8") as fh:
         raw = yaml.safe_load(fh) or {}
@@ -221,4 +245,5 @@ def load_config(path: str) -> CraneConfig:
         auth_token_file=str(
             (raw.get("Auth") or {}).get("TokenFile", "") or ""),
         auth_admins=[str(a) for a in
-                     (raw.get("Auth") or {}).get("Admins", ["root"])])
+                     (raw.get("Auth") or {}).get("Admins", ["root"])],
+        node_event_hook_path=str(raw.get("NodeEventHook", "") or ""))

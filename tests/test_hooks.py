@@ -1,0 +1,177 @@
+"""Lifecycle hooks: task prolog/epilog around real steps + node event
+fan-out.
+
+Reference: prolog/epilog scripts (etc/config.yaml:121-133,
+RunPrologOrEpiLog at JobScheduler.cpp:5470) and the plugin daemon's
+NodeEventHook surface (Plugin.proto:75-95).  Policy here: a failing
+prolog fails the step (exit 222) and drains the node; a failing epilog
+drains the node but leaves the job's outcome untouched."""
+
+import time
+
+import pytest
+
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=3.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    craneds = []
+
+    def add_craned(name, **kw):
+        d = CranedDaemon(name, f"127.0.0.1:{port}", cpu=8.0,
+                         mem_bytes=8 << 30, workdir=str(tmp_path),
+                         ping_interval=0.5,
+                         cgroup_root=str(tmp_path / "nocgroup"), **kw)
+        d.start()
+        craneds.append(d)
+        return d
+
+    yield sched, add_craned, tmp_path
+    for d in craneds:
+        d.stop()
+    dispatcher.close()
+    server.stop()
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_prolog_and_epilog_run_around_the_step(plane):
+    sched, add_craned, tmp_path = plane
+    trace = tmp_path / "trace.txt"
+    d = add_craned(
+        "hk00",
+        prolog=f"echo prolog:$CRANE_JOB_ID >> {trace}",
+        epilog=f"echo epilog:$CRANE_JOB_ID >> {trace}")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script=f"echo job:$CRANE_JOB_ID >> {trace}"), now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.COMPLETED)
+    assert wait_for(lambda: trace.exists()
+                    and len(trace.read_text().splitlines()) == 3)
+    lines = trace.read_text().splitlines()
+    assert lines == [f"prolog:{jid}", f"job:{jid}", f"epilog:{jid}"]
+
+
+def test_failing_prolog_fails_step_and_drains_node(plane):
+    sched, add_craned, tmp_path = plane
+    marker = tmp_path / "ran.txt"
+    d = add_craned("hk01", prolog="exit 9")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script=f"touch {marker}"), now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.FAILED)
+    assert sched.job_info(jid).exit_code == 222
+    assert not marker.exists()          # the user command never ran
+    node = sched.meta.node_by_name("hk01")
+    assert wait_for(lambda: node.health_drained)
+    assert "prolog failed" in node.health_message
+    # drained node receives no further work
+    j2 = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                              script="true"), now=time.time())
+    time.sleep(1.0)
+    assert sched.job_info(j2).status == JobStatus.PENDING
+
+
+def test_failing_epilog_drains_but_preserves_job_outcome(plane):
+    sched, add_craned, tmp_path = plane
+    d = add_craned("hk02", epilog="exit 3")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                               script="exit 0"), now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.COMPLETED)
+    assert sched.job_info(jid).exit_code == 0
+    node = sched.meta.node_by_name("hk02")
+    assert wait_for(lambda: node.health_drained)
+    assert "epilog failed" in node.health_message
+
+
+def test_node_events_fan_out(plane):
+    sched, add_craned, tmp_path = plane
+    seen = []
+    sched.node_event_hook = lambda ev: seen.append(
+        (ev["event"], ev["node"]))
+    d = add_craned("ev00")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    assert wait_for(lambda: ("node_up", "ev00") in seen)
+    # operator drain/undrain
+    sched.meta.drain(sched.meta.node_by_name("ev00").node_id, True)
+    # (direct meta call does not emit; the RPC surface does — use it)
+    from cranesched_tpu.rpc import CtldClient
+    # events recorded in the bounded log too
+    assert any(e["event"] == "node_up" for e in sched.node_events)
+    # node death
+    d.stop(graceful=False)
+    assert wait_for(lambda: ("node_down", "ev00") in seen,
+                    timeout=15.0)
+
+
+def test_chatty_hooks_cannot_corrupt_the_report_protocol(plane):
+    """A hook that writes to stdout (no redirect) and reads stdin must
+    not corrupt the supervisor's one-line report pipe or swallow
+    control verbs (review finding: hooks inherited both pipes)."""
+    sched, add_craned, tmp_path = plane
+    d = add_craned("hk03",
+                   prolog="echo chatty prolog output; cat >/dev/null "
+                          "</dev/null; true",
+                   epilog="echo chatty epilog; true")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                               script="exit 0"), now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.COMPLETED)
+    assert sched.job_info(jid).exit_code == 0
+    node = sched.meta.node_by_name("hk03")
+    assert not node.health_drained      # hooks succeeded, no drain
+
+
+def test_operator_resume_clears_hook_drain(plane):
+    """A hook-failure drain must be clearable by `cnode resume` (it
+    rides the health flag; without a health program nothing else would
+    ever clear it)."""
+    sched, add_craned, tmp_path = plane
+    d = add_craned("hk04", epilog="exit 1")
+    assert wait_for(lambda: d.state == CranedState.READY)
+    jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                               script="true"), now=time.time())
+    assert wait_for(
+        lambda: sched.job_info(jid).status == JobStatus.COMPLETED)
+    node = sched.meta.node_by_name("hk04")
+    assert wait_for(lambda: node.health_drained)
+    # the RPC resume path clears BOTH drain flags
+    from cranesched_tpu.rpc import CtldClient
+    client = CtldClient(d.ctld_address)
+    try:
+        assert client.modify_node("hk04", "resume").ok
+    finally:
+        client.close()
+    assert not node.health_drained and not node.drained
