@@ -144,6 +144,7 @@ def main() -> int:
     state = jax.device_put(state, dev)
     jobs = jax.device_put(jobs, dev)
 
+    from cranesched_tpu.models.pallas_solver import solve_greedy_pallas
     from cranesched_tpu.models.speculative import solve_blocked
     from cranesched_tpu.utils import native
 
@@ -167,11 +168,26 @@ def main() -> int:
             placed = out[0]
         return _P, None
 
+    # the Pallas path takes eligibility as (job_class, class_masks)
+    # instead of the dense [J, N] part_mask (see models/pallas_solver.py)
+    class_masks = jnp.asarray(
+        np.stack([np.asarray(node_part) == c for c in range(4)]))
+
+    def run_pallas():
+        return solve_greedy_pallas(
+            state, jobs.req, jobs.node_num, jobs.time_limit, jobs.valid,
+            job_part, class_masks, max_nodes=2)
+
     solvers = {
         "greedy": lambda: solve_greedy(state, jobs, max_nodes=2),
         "blocked": lambda: solve_blocked(state, jobs, max_nodes=2,
                                          block_size=128),
     }
+    if dev.platform == "tpu":
+        # the single-kernel Pallas solve is the TPU hot path (VMEM-
+        # resident cluster state, no per-job dispatch); it does not
+        # lower on the CPU backend (interpret mode is test-only)
+        solvers["pallas"] = run_pallas
     if dev.platform == "cpu" and native.available():
         # the host C++ solver only competes for the headline number when
         # the measurement is a CPU measurement anyway — on a real TPU the
@@ -184,10 +200,11 @@ def main() -> int:
                               f"use one of {['auto', *solvers]}"}))
             return 1
         solvers = {which: solvers[which]}
-    elif dev.platform == "cpu" and num_jobs * num_nodes > 10_000_000:
-        # the blocked solver's parallel validation is built for TPU
-        # throughput; on the CPU fallback at large shapes it would blow
-        # the bench budget, so auto mode times only the greedy scan there
+    elif num_jobs * num_nodes > 10_000_000:
+        # the blocked solver's parallel validation measured ~17 s/cycle
+        # on TPU and worse on CPU at the north-star shape (BENCH_r04);
+        # auto mode drops it there.  The scan greedy stays as the
+        # reference point against the Pallas kernel.
         solvers.pop("blocked", None)
 
     results = {}

@@ -642,7 +642,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("user")
     p.add_argument("--revoke", action="store_true")
     p.add_argument("--save", action="store_true",
-                   help="write the issued token to ~/.crane/token")
+                   help="write the issued token to ~/.crane/token.<user>")
     p.set_defaults(func=cmd_ctoken)
 
     p = sub.add_parser("cstep", help="list a job's steps")

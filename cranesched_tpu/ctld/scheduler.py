@@ -187,6 +187,11 @@ class JobScheduler:
         self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
         # job_id -> last kill-send time for unconfirmed cancel intents
         self._cancel_kill_sent: dict[int, float] = {}
+        # (job_id, step_id) -> last kill-send time for unconfirmed
+        # step-level cancels (same lost-kill race as whole-job cancel:
+        # dispatch_terminate_step swallows transport errors, so a single
+        # send can vanish and the cancelled step would run to completion)
+        self._step_cancel_sent: dict[tuple[int, int], float] = {}
         self._finalized_since_compact = 0
         # incremental per-cycle state of running allocations: the cost
         # seed + backfill release rows come from O(rows) numpy instead
@@ -950,6 +955,7 @@ class JobScheduler:
                 self.wal.job_updated(job)
             return True
         self.dispatch_terminate_step(job_id, step_id, now)
+        self._step_cancel_sent[(job_id, step_id)] = now
         if self.wal is not None:
             self.wal.job_updated(job)
         return True
@@ -1014,6 +1020,7 @@ class JobScheduler:
         step.status = status
         step.end_time = now
         step.exit_code = exit_code
+        self._step_cancel_sent.pop((job_id, step_id), None)
         if self.wal is not None:
             self.wal.job_updated(job)
         if step_id == 0 and not job.spec.alloc_only:
@@ -1148,6 +1155,20 @@ class JobScheduler:
                 continue
             self._cancel_kill_sent[job_id] = now
             self.dispatch_terminate(job_id, now)
+        # step-level cancel intents renew identically (ADVICE r3: a lost
+        # TerminateStep left a cancelled step running forever)
+        for key, last in list(self._step_cancel_sent.items()):
+            job_id, step_id = key
+            job = self.running.get(job_id)
+            step = job.steps.get(step_id) if job is not None else None
+            if (step is None or step.status.is_terminal
+                    or not step.cancel_requested):
+                self._step_cancel_sent.pop(key, None)
+                continue
+            if now - last < self.CANCEL_RENEW_INTERVAL:
+                continue
+            self._step_cancel_sent[key] = now
+            self.dispatch_terminate_step(job_id, step_id, now)
 
     # ------------------------------------------------------------------
     # THE scheduling cycle (reference ScheduleThread_ :1321-1981)

@@ -1,0 +1,303 @@
+"""The greedy placement cycle as ONE Pallas TPU kernel.
+
+Why: the lax.scan solver (models/solver.py) is semantically exact but
+latency-bound on TPU — 100k scan steps of ~15 tiny kernels each measured
+2.75 s/cycle at the north-star shape (BENCH_r03/r04 greedy), entirely
+dispatch/latency overhead: the actual arithmetic is ~10 GOP.  The
+TPU-native fix is to run the WHOLE job loop inside a single kernel:
+
+* cluster state (``avail`` transposed and folded to [R, 8, N/8], the
+  int32 cost ledger, per-eligibility-class node masks) lives in VMEM
+  scratch for the whole solve — at 10k nodes that is ~0.5 MB, far under
+  the ~16 MB/core budget, read/updated at VPU speed with zero HBM
+  traffic;
+* per-job scalars (req, node_num, time_limit, class id, valid) stream
+  through SMEM in blocks of ``BJ`` jobs per grid step;
+* each job is ~30 full-width VPU ops (feasibility compare per resource
+  dim, masked min for the cheapest-k selection, masked subtract/add for
+  the resource/cost update) — no dynamic-index gathers or scatters at
+  all: selection and update are both expressed as elementwise ops
+  against a node-index iota, which is exactly what the VPU wants.  The
+  node axis is folded to (8 sublanes, N/8 lanes) so every op fills the
+  full 8x128 VPU instead of one sublane.
+
+Semantics are bit-identical to ``solver.solve_greedy`` (same fixed-point
+cost ledger, same (cost, lowest-index) tie order, same decide_job
+admission + pending reasons — asserted in tests/test_pallas_parity.py).
+The one interface difference: per-job node eligibility arrives as
+``job_class[J]`` + ``class_masks[C, N]`` instead of a dense
+``part_mask[J, N]`` — the [J, N] matrix at 100k x 10k is a 1 GB bool
+that neither HBM nor the control plane wants, while real clusters have a
+handful of distinct (partition x include/exclude) masks (reference:
+partition membership drives eligibility,
+src/CraneCtld/JobScheduler.cpp:6516-6607).
+
+Reference for the loop semantics: LocalScheduler::GetNodesAndTrySchedule_
+walks nodes in ascending cost order and takes the first node_num that fit
+(src/CraneCtld/JobScheduler.cpp:6147-6369); the cost policy is
+MinCpuTimeRatioFirst (JobScheduler.h:40-54).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    COST_SCALE,
+    ClusterState,
+    JobBatch,
+    Placements,
+    REASON_CONSTRAINT,
+    REASON_NONE,
+    REASON_RESOURCE,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+# node axis is folded to (SUB, N/SUB) so every vector op fills all 8
+# sublanes x 128 lanes of the VPU instead of 1/8th of it
+SUB = 8
+LANES = 128
+NODE_TILE = SUB * LANES  # node padding quantum (1024)
+
+
+def _pad_to(x, size, axis, value):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def classes_from_part_mask(part_mask) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side helper (tests / adapters): compress a dense [J, N]
+    eligibility matrix into (job_class[J], class_masks[C, N])."""
+    pm = np.asarray(part_mask, bool)
+    classes, inverse = np.unique(pm, axis=0, return_inverse=True)
+    return inverse.astype(np.int32), classes
+
+
+def _make_kernel(BJ: int, K: int, R: int, W: int):
+    # all per-job scalars ride in ONE SMEM window (layout [BJ, R+4]:
+    # req dims, node_num, time_limit, valid, class) — SMEM windows are
+    # padded to 1 KiB/row and double-buffered, so five separate arrays
+    # blow the ~1 MiB SMEM budget while one fits comfortably
+    def kernel(job_s, nelig_s,                           # SMEM scalars
+               avail_in, cost_in, elig_in, cputot_in,    # VMEM cluster in
+               placed_o, chosen_o, reason_o, avail_o, cost_o,  # outputs
+               avail_s, cost_s, placed_s, chosen_s, reason_s):  # scratch
+        nb = pl.num_programs(0)
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            avail_s[...] = avail_in[...]
+            cost_s[...] = cost_in[...]
+
+        # global node index at each (sublane, lane) position; masked mins
+        # over it resolve cost ties to the LOWEST node id, matching the
+        # scan solver's argmin-first-occurrence order
+        nid = (jax.lax.broadcasted_iota(jnp.int32, (SUB, W), 0) * W
+               + jax.lax.broadcasted_iota(jnp.int32, (SUB, W), 1))
+        jlane = jax.lax.broadcasted_iota(jnp.int32, (1, BJ), 1)
+        inf = jnp.int32(COST_INF)
+        npad = jnp.int32(SUB * W)
+
+        placed_s[...] = jnp.zeros((1, BJ), jnp.int32)
+        reason_s[...] = jnp.zeros((1, BJ), jnp.int32)
+        chosen_s[...] = jnp.full((K, BJ), -1, jnp.int32)
+
+        def job_body(j, carry):
+            nn = job_s[j, R]
+            tl = job_s[j, R + 1]
+            valid = job_s[j, R + 2] != 0
+            cls = job_s[j, R + 3]
+
+            feas = elig_in[cls] != 0                     # [SUB, W]
+            for r in range(R):
+                feas = feas & (avail_s[r] >= job_s[j, r])
+
+            # --- selection pass: K masked mins (reduction-only) ---
+            mcost = jnp.where(feas, cost_s[0], inf)      # [SUB, W]
+            ms, idxs = [], []
+            for k in range(K):
+                m = jnp.min(mcost)
+                idx = jnp.min(jnp.where(mcost == m, nid, npad))
+                ms.append(m)
+                idxs.append(idx)
+                # mask the winner for the next gang member (cheapest_k
+                # masks unconditionally; on an all-INF row the mask is
+                # a no-op, same as cheapest_k)
+                if k + 1 < K:
+                    mcost = jnp.where(nid == idx, inf, mcost)
+
+            # --- admission (decide_job): the masked minima are sorted
+            # ascending, so "at least nn feasible nodes" is exactly "at
+            # least nn finite minima" — no O(N) popcount needed.  The
+            # eligible count is solve-invariant and precomputed per
+            # class host-side.
+            cnt_finite = jnp.int32(0)
+            for k in range(K):
+                cnt_finite = cnt_finite + (ms[k] < inf).astype(jnp.int32)
+            ok = valid & (nn > 0) & (nn <= K) & (cnt_finite >= nn)
+            bad = jnp.logical_not(valid) | (nn <= 0)
+            never = bad | (nelig_s[cls, 0] < nn)
+            reason = jnp.where(ok, REASON_NONE,
+                               jnp.where(never, REASON_CONSTRAINT,
+                                         REASON_RESOURCE))
+
+            # --- one combined update for all gang members ---
+            win = jnp.zeros((SUB, W), bool)
+            for k in range(K):
+                take = ok & (k < nn) & (ms[k] < inf)
+                win = win | ((nid == idxs[k]) & take)
+                chosen_s[k:k + 1, :] = jnp.where(
+                    (jlane == j) & take, idxs[k], chosen_s[k:k + 1, :])
+            # MinCpuTimeRatioFirst increment, elementwise over nodes
+            # with this job's scalars — identical f32 expression (and
+            # associativity) to solver.quantized_dcost
+            dcost = jnp.round(
+                tl.astype(jnp.float32)
+                * job_s[j, DIM_CPU].astype(jnp.float32)
+                * jnp.float32(COST_SCALE)
+                / cputot_in[0]).astype(jnp.int32)
+            for r in range(R):
+                avail_s[r] = avail_s[r] - jnp.where(win, job_s[j, r], 0)
+            cost_s[0] = cost_s[0] + jnp.where(win, dcost, 0)
+
+            placed_s[...] = jnp.where(jlane == j, ok.astype(jnp.int32),
+                                      placed_s[...])
+            reason_s[...] = jnp.where(jlane == j, reason, reason_s[...])
+            return carry
+
+        jax.lax.fori_loop(0, BJ, job_body, jnp.int32(0))
+
+        # per-job outputs live whole in VMEM (tiny); write this block's
+        # row at a dynamic offset — blocked specs would need a
+        # sublane-divisible leading block dim the (NB, BJ) shape lacks
+        placed_o[pl.ds(step, 1), :] = placed_s[...]
+        chosen_o[pl.ds(step, 1), :, :] = chosen_s[...][None]
+        reason_o[pl.ds(step, 1), :] = reason_s[...]
+
+        @pl.when(step == nb - 1)
+        def _():
+            avail_o[...] = avail_s[...]
+            cost_o[...] = cost_s[...]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "block_jobs",
+                                             "interpret"))
+def solve_greedy_pallas(state: ClusterState, req, node_num, time_limit,
+                        valid, job_class, class_masks,
+                        max_nodes: int = 1, block_jobs: int = 256,
+                        interpret: bool = False
+                        ) -> tuple[Placements, ClusterState]:
+    """Single-kernel greedy solve.  Same contract as ``solve_greedy``
+    with eligibility given as (job_class, class_masks); returns
+    (Placements, new ClusterState)."""
+    J = req.shape[0]
+    N = state.num_nodes
+    R = state.num_dims
+    K = min(max_nodes, N)
+    BJ = block_jobs
+
+    n_pad = -(-N // NODE_TILE) * NODE_TILE
+    W = n_pad // SUB
+    j_pad = -(-max(J, 1) // BJ) * BJ
+    NB = j_pad // BJ
+    C = class_masks.shape[0]
+
+    # --- node-axis tensors, folded to [.., SUB, W] ---
+    availT = _pad_to(state.avail.T.astype(jnp.int32), n_pad, 1, 0)
+    avail3 = availT.reshape(R, SUB, W)
+    cost2 = _pad_to(state.cost.astype(jnp.int32)[None, :], n_pad, 1,
+                    COST_INF).reshape(1, SUB, W)
+    elig = class_masks.astype(jnp.int32) * state.alive.astype(jnp.int32)
+    elig3 = _pad_to(elig, n_pad, 1, 0).reshape(C, SUB, W)
+    nelig = jnp.sum(elig, axis=1, dtype=jnp.int32)[:, None]  # [C, 1]
+    cputot = jnp.maximum(state.total[:, DIM_CPU], 1).astype(jnp.float32)
+    cputot3 = _pad_to(cputot[None, :], n_pad, 1, 1.0).reshape(1, SUB, W)
+
+    # --- job scalars, padded to NB * BJ ---
+    def padj(x, value=0):
+        return _pad_to(jnp.asarray(x), j_pad, 0, value)
+
+    job_p = padj(jnp.concatenate([
+        req.astype(jnp.int32),
+        node_num.astype(jnp.int32)[:, None],
+        time_limit.astype(jnp.int32)[:, None],
+        valid.astype(jnp.int32)[:, None],
+        jnp.clip(job_class.astype(jnp.int32), 0, C - 1)[:, None],
+    ], axis=1))                                        # [Jp, R + 4]
+
+    def smem_j(width):
+        return pl.BlockSpec((BJ, width), lambda i: (i, 0),
+                            memory_space=pltpu.SMEM)
+
+    def vmem_full():
+        return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((NB, BJ), jnp.int32),        # placed
+        jax.ShapeDtypeStruct((NB, K, BJ), jnp.int32),     # chosen
+        jax.ShapeDtypeStruct((NB, BJ), jnp.int32),        # reason
+        jax.ShapeDtypeStruct((R, SUB, W), jnp.int32),     # avail out
+        jax.ShapeDtypeStruct((1, SUB, W), jnp.int32),     # cost out
+    )
+    out_specs = (
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+    placed, chosen, reason, avail_f, cost_f = pl.pallas_call(
+        _make_kernel(BJ, K, R, W),
+        grid=(NB,),
+        in_specs=[smem_j(R + 4),
+                  pl.BlockSpec((C, 1), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  vmem_full(), vmem_full(), vmem_full(), vmem_full()],
+        out_shape=out_shapes,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((R, SUB, W), jnp.int32),
+            pltpu.VMEM((1, SUB, W), jnp.int32),
+            pltpu.VMEM((1, BJ), jnp.int32),
+            pltpu.VMEM((K, BJ), jnp.int32),
+            pltpu.VMEM((1, BJ), jnp.int32),
+        ],
+        interpret=interpret,
+    )(job_p, nelig, avail3, cost2, elig3, cputot3)
+
+    placed = placed.reshape(-1)[:J].astype(bool)
+    nodes = chosen.transpose(0, 2, 1).reshape(-1, K)[:J]
+    reason = reason.reshape(-1)[:J]
+    avail_new = avail_f.reshape(R, n_pad)[:, :N].T
+    cost_new = cost_f.reshape(n_pad)[:N]
+    new_state = state.replace(avail=avail_new, cost=cost_new)
+    return Placements(placed=placed, nodes=nodes, reason=reason), new_state
+
+
+def solve_greedy_pallas_from_batch(state: ClusterState, jobs: JobBatch,
+                                   max_nodes: int = 1,
+                                   interpret: bool = False
+                                   ) -> tuple[Placements, ClusterState]:
+    """Adapter for callers holding a dense part_mask (tests, small
+    cycles): compress to eligibility classes host-side, then run the
+    kernel.  Not for the 100k x 10k hot path — pass classes directly."""
+    job_class, class_masks = classes_from_part_mask(jobs.part_mask)
+    return solve_greedy_pallas(
+        state, jobs.req, jobs.node_num, jobs.time_limit, jobs.valid,
+        jnp.asarray(job_class), jnp.asarray(class_masks),
+        max_nodes=max_nodes, interpret=interpret)

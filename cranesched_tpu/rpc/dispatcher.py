@@ -162,11 +162,22 @@ class GrpcDispatcher:
         nodes = self.scheduler.meta.nodes
         names = [nodes[n].name if n in nodes else f"?{n}"
                  for n in node_ids]
-        # deterministic per-(job, step) port in a high range: two
-        # concurrent steps of one allocation must not share a
-        # coordinator endpoint; collisions need two live gangs whose
-        # mixed ids land 20k apart on a shared rank-0 host
-        port = 28000 + ((job_id * 131 + step_id) % 20000)
+        # deterministic per-(job, step, incarnation) port in a high
+        # range: two concurrent steps of one allocation must not share a
+        # coordinator endpoint.  Hashing removes the old job_id*131
+        # lattice correlation, but the port space is still 20000, so two
+        # concurrently live gangs sharing a rank-0 host collide with
+        # ~1/20000 probability per pair (birthday regime near ~170 such
+        # gangs).  Residual risk accepted for the env-only bootstrap;
+        # the fix-proper (rank-0 picks a free port and reports back)
+        # needs a supervisor round-trip this path deliberately avoids
+        incarnation = self.scheduler.running[job_id].requeue_count \
+            if job_id in self.scheduler.running else 0
+        import hashlib
+        digest = hashlib.blake2b(
+            f"{job_id}/{step_id}/{incarnation}".encode(),
+            digest_size=8).digest()
+        port = 28000 + (int.from_bytes(digest, "big") % 20000)
         return {
             "nodelist": compress_hostlist(names),
             "rank": {n: i for i, n in enumerate(node_ids)},

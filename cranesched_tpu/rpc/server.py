@@ -117,14 +117,40 @@ class CtldServer:
             return "permission denied (admin required)"
         return ""
 
-    def _deny_internal(self, ident) -> str:
-        """Craned-internal surface: the cluster secret or an admin."""
+    def _deny_internal(self, ident, node_id: int | None = None,
+                       node_name: str | None = None) -> str:
+        """Craned-internal surface: a craned identity or an admin.
+
+        A per-node token (identity ``@craned/<name>``, ADVICE r3) is
+        additionally bound to the node it names: an RPC that claims a
+        ``node_id``/name is denied when the token belongs to a different
+        node, so one compromised craned cannot forge reports for the
+        rest of the plane.  The shared ``@craned`` cluster secret (and
+        admins) keep plane-wide access for sim/small deployments."""
         if self.auth is None:
             return ""
-        from cranesched_tpu.ctld.auth import CRANED_IDENTITY
-        if ident == CRANED_IDENTITY or self.auth.is_admin(ident):
+        from cranesched_tpu.ctld.auth import craned_node_of
+        bound = craned_node_of(ident)
+        if bound is None:
+            if self.auth.is_admin(ident):
+                return ""
+            return "craned authentication required"
+        if bound == "*":
             return ""
-        return "craned authentication required"
+        if node_name is None and node_id is not None:
+            node = self.scheduler.meta.nodes.get(node_id)
+            node_name = node.name if node is not None else None
+        if node_name is None:
+            # fail CLOSED: an unresolvable node claim (unknown id, or
+            # the node_id=-1 whole-job report form only the sim plane
+            # uses) would otherwise let a node-bound token act outside
+            # its binding — exactly the impersonation it exists to stop
+            return (f"token is bound to node {bound!r} but the request "
+                    "names no resolvable node")
+        if node_name != bound:
+            return (f"token is bound to node {bound!r}, not "
+                    f"{node_name!r}")
+        return ""
 
     # ---- handlers (each is unary-unary; the lock serializes) ----
 
@@ -474,7 +500,8 @@ class CtldServer:
         """Health-check report (reference HealthCheck config,
         Craned.cpp:731-751): unhealthy nodes drain until they report
         healthy again."""
-        deny = self._deny_internal(self._ident(context))
+        deny = self._deny_internal(self._ident(context),
+                                   node_id=request.node_id)
         if deny:
             return pb.OkReply(ok=False, error=deny)
         with self._lock:
@@ -520,7 +547,8 @@ class CtldServer:
     # ---- internal (node plane + virtual time) ----
 
     def CranedRegister(self, request, context):
-        deny = self._deny_internal(self._ident(context))
+        deny = self._deny_internal(self._ident(context),
+                                   node_name=request.name)
         if deny:
             return pb.CranedRegisterReply(ok=False, error=deny)
         with self._lock:
@@ -579,7 +607,8 @@ class CtldServer:
                                           expected_jobs=expected)
 
     def CranedPing(self, request, context):
-        deny = self._deny_internal(self._ident(context))
+        deny = self._deny_internal(self._ident(context),
+                                   node_id=request.node_id)
         if deny:
             return pb.OkReply(ok=False, error=deny)
         with self._lock:
@@ -595,7 +624,8 @@ class CtldServer:
             return pb.OkReply(ok=True)
 
     def StepStatusChange(self, request, context):
-        deny = self._deny_internal(self._ident(context))
+        deny = self._deny_internal(self._ident(context),
+                                   node_id=request.node_id)
         if deny:
             return pb.OkReply(ok=False, error=deny)
         with self._lock:

@@ -190,3 +190,92 @@ def test_tokens_persist_across_restart(tmp_path):
     assert a2.identity((("crane-token", t),)) == "alice"
     assert a2.root_token == a1.root_token
     assert a2.craned_token == a1.craned_token
+
+
+def test_per_node_craned_token_is_bound_to_its_node(secured):
+    """ADVICE r3: a per-node token (@craned/<name>) must not be able to
+    impersonate other nodes on the internal surface."""
+    sched, auth, root, client_for, addr = secured
+    t0 = auth.issue_craned("root", "cn0")
+    cn0 = CtldClient(addr, token=t0)
+    try:
+        # registering as its own name works, as another name is refused
+        total = pb.ResourceSpec(cpu=4.0, mem_bytes=8 << 30)
+        assert not cn0.craned_register("cn1", total).ok
+        r = cn0.craned_register("cn0", total)
+        assert r.ok
+        assert cn0.craned_ping(r.node_id).ok          # own node_id: ok
+        other = sched.meta.node_by_name("cn1").node_id
+        assert not cn0.craned_ping(other).ok          # foreign: denied
+    finally:
+        cn0.close()
+
+
+def test_token_table_stores_hashes_not_plaintext(tmp_path):
+    """ADVICE r3: a leaked table file must not contain usable tokens."""
+    import json as _json
+    path = str(tmp_path / "tok.json")
+    a = AuthManager(path)
+    t = a.issue("root", "alice")
+    with open(path, encoding="utf-8") as fh:
+        table = _json.load(fh)
+    assert t not in table                     # no plaintext row
+    assert a.root_token not in table
+    assert all(len(k) == 64 for k in table)   # sha256 hex keys only
+    # and the hashes still authenticate
+    assert a.identity((("crane-token", t),)) == "alice"
+
+
+def test_legacy_plaintext_table_migrates_to_hashes(tmp_path):
+    import json as _json
+    path = str(tmp_path / "tok.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        _json.dump({"OLDROOT": "root", "OLDSECRET": "@craned",
+                    "ALICETOK": "alice"}, fh)
+    a = AuthManager(path)
+    assert a.root_token == "OLDROOT"          # daemon creds recovered
+    assert a.craned_token == "OLDSECRET"
+    assert a.identity((("crane-token", "ALICETOK"),)) == "alice"
+    with open(path, encoding="utf-8") as fh:
+        table = _json.load(fh)
+    assert "ALICETOK" not in table            # rewritten as hash
+
+
+def test_node_bound_token_cannot_use_unresolvable_node_id(secured):
+    """Fail closed: a per-node token sending an unknown or -1 node_id
+    (the whole-job report form) must be denied, not skipped past the
+    binding check."""
+    sched, auth, root, client_for, addr = secured
+    t0 = auth.issue_craned("root", "cn0")
+    cn0 = CtldClient(addr, token=t0)
+    try:
+        assert not cn0.craned_ping(999).ok       # unknown node id
+        r = cn0.step_status_change(1, "FAILED", 1, 0.0, node_id=-1)
+        assert not r.ok and "bound to node" in r.error
+    finally:
+        cn0.close()
+
+
+def test_revoking_bootstrap_identity_rotates_keyring(tmp_path):
+    """Revoking '@craned' must survive a restart: the keyring credential
+    rotates, so the old secret cannot resurrect via bootstrap."""
+    path = str(tmp_path / "tok.json")
+    a1 = AuthManager(path)
+    old = a1.craned_token
+    assert a1.revoke("root", "@craned") >= 1
+    assert a1.identity((("crane-token", old),)) is None
+    assert a1.craned_token != old                 # rotated in-session
+    a2 = AuthManager(path)                        # restart
+    assert a2.identity((("crane-token", old),)) is None
+    assert a2.craned_token == a1.craned_token
+
+
+def test_legacy_migration_persists_keyring_across_two_restarts(tmp_path):
+    import json as _json
+    path = str(tmp_path / "tok.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        _json.dump({"OLDROOT": "root", "OLDSECRET": "@craned"}, fh)
+    a1 = AuthManager(path)                        # migration restart
+    a2 = AuthManager(path)                        # second restart
+    assert a2.root_token == "OLDROOT"             # not silently rotated
+    assert a2.craned_token == "OLDSECRET"
