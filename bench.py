@@ -178,11 +178,62 @@ def main() -> int:
             state, jobs.req, jobs.node_num, jobs.time_limit, jobs.valid,
             job_part, class_masks, max_nodes=2)
 
+    def run_backfill():
+        # the time-axis solve at the same shape (VERDICT r3 #5: a
+        # recorded backfill number).  T=64 buckets, idle-cluster map
+        # (the map build is measured separately in real cycles).
+        from cranesched_tpu.models.solver_time import (
+            TimedJobBatch, make_timed_state, solve_backfill)
+        tstate = make_timed_state(
+            state.avail, state.total, state.alive,
+            np.zeros((0, 1), np.int32), np.zeros((0, req.shape[1]),
+                                                 np.int32),
+            np.zeros(0, np.int32), num_buckets=64, cost=state.cost)
+        tjobs = TimedJobBatch(
+            req=jobs.req, node_num=jobs.node_num,
+            time_limit=jobs.time_limit,
+            dur_buckets=jnp.clip(jobs.time_limit // 60, 1, 64),
+            part_mask=jobs.part_mask, valid=jobs.valid)
+        return solve_backfill(tstate, tjobs, max_nodes=2, group=8)
+
+    def run_backfill_split(bf_max=1024):
+        # the production composition for time-axis cycles at scale
+        # (SchedulerConfig.backfill_max_jobs): full timed solve for the
+        # top bf_max priority jobs, Pallas immediate solve for the tail
+        # against the min-over-horizon availability (reservation-safe)
+        from cranesched_tpu.models.solver_time import (
+            TimedJobBatch, make_timed_state, solve_backfill)
+        tstate = make_timed_state(
+            state.avail, state.total, state.alive,
+            np.zeros((0, 1), np.int32), np.zeros((0, req.shape[1]),
+                                                 np.int32),
+            np.zeros(0, np.int32), num_buckets=64, cost=state.cost)
+        head = jax.tree.map(lambda x: x[:bf_max], jobs)
+        tjobs = TimedJobBatch(
+            req=head.req, node_num=head.node_num,
+            time_limit=head.time_limit,
+            dur_buckets=jnp.clip(head.time_limit // 60, 1, 64),
+            part_mask=head.part_mask, valid=head.valid)
+        tp, tstate = solve_backfill(tstate, tjobs, max_nodes=2, group=8)
+        min_avail = jnp.min(tstate.time_avail, axis=1)
+        tail_state = state.replace(avail=min_avail, cost=tstate.cost)
+        p2, _ = solve_greedy_pallas(
+            tail_state, jobs.req[bf_max:], jobs.node_num[bf_max:],
+            jobs.time_limit[bf_max:], jobs.valid[bf_max:],
+            job_part[bf_max:], class_masks, max_nodes=2)
+
+        class _P:
+            placed = jnp.concatenate([tp.placed, p2.placed])
+        return _P, None
+
     solvers = {
         "greedy": lambda: solve_greedy(state, jobs, max_nodes=2),
         "blocked": lambda: solve_blocked(state, jobs, max_nodes=2,
                                          block_size=128),
+        "backfill": run_backfill,
     }
+    if dev.platform == "tpu":
+        solvers["backfill_split"] = run_backfill_split
     if dev.platform == "tpu":
         # the single-kernel Pallas solve is the TPU hot path (VMEM-
         # resident cluster state, no per-job dispatch); it does not
@@ -203,9 +254,14 @@ def main() -> int:
     elif num_jobs * num_nodes > 10_000_000:
         # the blocked solver's parallel validation measured ~17 s/cycle
         # on TPU and worse on CPU at the north-star shape (BENCH_r04);
-        # auto mode drops it there.  The scan greedy stays as the
-        # reference point against the Pallas kernel.
+        # auto mode drops it there, and the time-axis backfill (~T x
+        # heavier per step) runs only when explicitly requested
+        # (BENCH_SOLVER=backfill — recorded in BENCH_r04_backfill.json).
+        # The scan greedy stays as the reference point against the
+        # Pallas kernel.
         solvers.pop("blocked", None)
+        solvers.pop("backfill", None)
+        solvers.pop("backfill_split", None)
 
     results = {}
     placed_by = {}

@@ -110,6 +110,19 @@ class RunLedger:
             self.end_time[i] = now + self.rem_const[i]
             self.suspended[i] = False
 
+    def set_end_time(self, job_id: int, end_time: float) -> None:
+        """Rebase the expected release (ccontrol modify time_limit) —
+        without this, every later time map would plan reservations
+        against the stale release bucket.  A suspended row keeps
+        freezing from the NEW end."""
+        for i in self._rows_of.get(job_id, ()):
+            if self.suspended[i]:
+                # preserve the frozen-remaining semantics relative to
+                # the new deadline: shift the stored remaining by the
+                # same delta the end moved
+                self.rem_const[i] += end_time - self.end_time[i]
+            self.end_time[i] = end_time
+
     # -- the per-cycle products (vectorized, no Python per-job loop) --
 
     def remaining(self, now: float) -> np.ndarray:

@@ -95,6 +95,16 @@ class SchedulerConfig:
     backfill: bool = True
     time_resolution: float = 60.0       # seconds per bucket
     time_buckets: int = 64              # horizon = resolution * buckets
+    # bounded backfill lookahead (the Slurm bf_max_job_test analog,
+    # default 1000; the reference bounds the same scan with
+    # ScheduledBatchSize): cycles larger than this run the timed solve
+    # only for the top-priority slice and place the tail with the fast
+    # immediate solver against the MIN-over-horizon availability — a
+    # tail job that fits the tightest bucket can never violate any
+    # reservation, so the split is strictly conservative.  Measured at
+    # 100k x 10k the full timed solve is ~15 s/cycle on TPU
+    # (BENCH_r04_backfill) while the split fits the 1 s cycle budget.
+    backfill_max_jobs: int = 1024
     # real node plane: a craned that misses pings for this long is down
     # (reference kCranedTimeoutSec = 30, PublicHeader.h:146)
     craned_timeout: float = 30.0
@@ -104,8 +114,13 @@ class SchedulerConfig:
     preempt_mode: str = "off"
     # solver backend for immediate-fit cycles: "auto" prefers the native
     # C++ treap solver (bit-identical, ~fastest single-host) and falls
-    # back to the device scan; "device" forces JAX; "native" requires the
-    # C++ library.  Backfill and packed cycles always run on device.
+    # back to the device scan; "device" forces the JAX scan; "native"
+    # requires the C++ library; "pallas" runs the single-kernel TPU
+    # solve (models/pallas_solver.py — interpret mode off-TPU, so only
+    # useful for tests there); "sharded" runs the node-axis-sharded
+    # multi-chip solve over every visible device
+    # (parallel/sharded.py).  Backfill and packed cycles always run on
+    # device.  All five are bit-identical on placements.
     solver: str = "auto"
 
     def __post_init__(self):
@@ -113,9 +128,11 @@ class SchedulerConfig:
             raise ValueError(
                 f"preempt_mode must be off|requeue|cancel, "
                 f"got {self.preempt_mode!r}")
-        if self.solver not in ("auto", "device", "native"):
+        if self.solver not in ("auto", "device", "native", "pallas",
+                               "sharded"):
             raise ValueError(
-                f"solver must be auto|device|native, got {self.solver!r}")
+                "solver must be auto|device|native|pallas|sharded, "
+                f"got {self.solver!r}")
 
 
 @dataclasses.dataclass
@@ -184,6 +201,7 @@ class JobScheduler:
         self._account_index: dict[str, int] = {}
         self._mask_cache: dict[tuple, np.ndarray] = {}
         self._mask_cache_epoch = -1
+        self._mesh = None  # lazy device mesh for solver == "sharded"
         self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
         # job_id -> last kill-send time for unconfirmed cancel intents
         self._cancel_kill_sent: dict[int, float] = {}
@@ -192,6 +210,11 @@ class JobScheduler:
         # dispatch_terminate_step swallows transport errors, so a single
         # send can vanish and the cancelled step would run to completion)
         self._step_cancel_sent: dict[tuple[int, int], float] = {}
+        # job_id -> (new time limit, last send) for unconfirmed
+        # ChangeTimeLimit pushes: the update can beat the supervisor
+        # spawn on the craned (which then refuses it), so it re-sends
+        # each cycle until the dispatcher confirms every node took it
+        self._limit_intents: dict[int, tuple[float, float]] = {}
         self._finalized_since_compact = 0
         # incremental per-cycle state of running allocations: the cost
         # seed + backfill release rows come from O(rows) numpy instead
@@ -522,6 +545,93 @@ class JobScheduler:
         if self.wal is not None:
             self.wal.job_updated(job)
         return True
+
+    def modify_job(self, job_id: int, now: float, *,
+                   time_limit: float | None = None,
+                   priority: int | None = None,
+                   partition: str | None = None) -> str:
+        """Modify a job in place (reference ModifyJob, Crane.proto:1447).
+        Returns "" on success, else the refusal reason.
+
+        time_limit applies to pending AND running jobs — for running
+        jobs the new deadline propagates to the supervisors through
+        ``dispatch_change_time_limit`` (the ChangeJobTimeConstraint
+        path, Crane.proto:1654), so a job about to hit its old limit is
+        NOT killed at it.  priority and partition change pending jobs
+        only (the reference likewise refuses to migrate a running job)."""
+        job = self.pending.get(job_id) or self.running.get(job_id)
+        if job is None:
+            return f"job {job_id} not found or already terminal"
+        running = job_id in self.running
+        if running and (priority is not None or partition is not None):
+            return "only the time limit of a running job can change"
+        if time_limit is not None:
+            if time_limit <= 0:
+                return "time limit must be positive"
+            if self.accounts is not None and job.qos_name:
+                qos = self.accounts.qos.get(job.qos_name)
+                if qos is not None and (
+                        time_limit > qos.max_time_limit_per_job
+                        or time_limit > qos.max_wall):
+                    return ("time limit exceeds qos "
+                            f"{job.qos_name} bound")
+        if partition is not None:
+            # full submit-time validation against the NEW partition
+            # (skipping it would let an owner bypass account ACLs or
+            # strand a gang in a partition that can never host it)
+            part = self.meta.partitions.get(partition)
+            if part is None:
+                return f"partition {partition} not found"
+            if not part.node_ids:
+                return f"partition {partition} has no nodes"
+            if not part.account_allowed(job.spec.account):
+                return (f"account {job.spec.account} not allowed in "
+                        f"partition {partition}")
+            if job.spec.node_num > len(part.node_ids):
+                return (f"gang of {job.spec.node_num} exceeds "
+                        f"partition {partition} size")
+            req = job.spec.res.encode(self.meta.layout)
+            if job.spec.task_res is not None:
+                req = req + (job.spec.task_res.encode(self.meta.layout)
+                             * job.spec.ntasks_per_node_min)
+            if not (req <= self.meta.partition_max_total(partition)
+                    ).all():
+                return (f"request exceeds every node in partition "
+                        f"{partition}")
+            if self.accounts is not None:
+                _qos, err = self.accounts.resolve_submit(
+                    job.spec.user, job.spec.account, partition,
+                    job.spec.qos or None)
+                if err:
+                    return err
+        import dataclasses as _dc
+        if time_limit is not None:
+            job.spec = _dc.replace(job.spec,
+                                   time_limit=float(time_limit))
+            if running:
+                # the incremental ledger's release row must follow the
+                # new deadline, or every later time map would reserve
+                # against a bucket the job will still occupy
+                self._ledger.set_end_time(
+                    job_id, self._effective_end(job, now))
+                self._limit_intents[job_id] = (float(time_limit), now)
+                self.dispatch_change_time_limit(job_id, float(time_limit),
+                                                now)
+        if priority is not None:
+            job.qos_priority = int(priority)
+        if partition is not None:
+            job.spec = _dc.replace(job.spec, partition=partition)
+            job.pending_reason = PendingReason.NONE
+        if self.wal is not None:
+            self.wal.job_updated(job)
+        return ""
+
+    def dispatch_change_time_limit(self, job_id: int, time_limit: float,
+                                   now: float) -> None:
+        """Transport seam: push the new deadline to the job's craneds.
+        The sim plane has no supervisors to update (deadlines re-read
+        spec.time_limit), so the base seam just confirms the intent."""
+        self._limit_intents.pop(job_id, None)
 
     # ------------------------------------------------------------------
     # status changes (reference StepStatusChangeAsync :5294 + batched
@@ -1169,6 +1279,16 @@ class JobScheduler:
                 continue
             self._step_cancel_sent[key] = now
             self.dispatch_terminate_step(job_id, step_id, now)
+        # unconfirmed time-limit pushes renew every cycle (idempotent;
+        # the dispatcher pops the intent once every node accepted) —
+        # the update must land before the OLD deadline fires, so no
+        # backoff: a modify is rare and the fan-out is tiny
+        for job_id, (limit, _last) in list(self._limit_intents.items()):
+            job = self.running.get(job_id)
+            if job is None or job.spec.time_limit != limit:
+                self._limit_intents.pop(job_id, None)
+                continue
+            self.dispatch_change_time_limit(job_id, limit, now)
 
     # ------------------------------------------------------------------
     # THE scheduling cycle (reference ScheduleThread_ :1321-1981)
@@ -1232,26 +1352,25 @@ class JobScheduler:
             return started
 
         if self.config.backfill:
+            bf_max = max(1, self.config.backfill_max_jobs)
+            if len(ordered) > bf_max:
+                started = self._split_backfill_cycle(
+                    ordered, jobs_batch, avail, total, alive, cost0,
+                    max_nodes, now)
+                started += self._try_preemption(ordered, now)
+                self._record_cycle_stats(t0, t_prelude, candidates,
+                                         started,
+                                         _time.perf_counter(),
+                                         "backfill-split")
+                return started
             state = self._timed_state(now, avail, total, alive, cost0)
             tbatch = self._timed_batch(jobs_batch, ordered)
             placements, _ = solve_backfill(state, tbatch,
                                            max_nodes=max_nodes)
             start_buckets = np.asarray(placements.start_bucket)
         else:
-            placements = None
-            solver_name = "immediate"
-            if self.config.solver in ("auto", "native"):
-                placements = self._solve_native(avail, total, alive,
-                                                cost0, jobs_batch,
-                                                max_nodes)
-                if placements is not None:
-                    solver_name = "native"
-                elif self.config.solver == "native":
-                    raise RuntimeError("native solver unavailable")
-            if placements is None:
-                state = make_cluster_state(avail, total, alive, cost0)
-                placements, _ = solve_greedy(state, jobs_batch,
-                                             max_nodes=max_nodes)
+            placements, solver_name = self._immediate_solve(
+                avail, total, alive, cost0, jobs_batch, max_nodes)
             start_buckets = None
 
         started = self._commit(ordered, placements, now, start_buckets)
@@ -1259,6 +1378,83 @@ class JobScheduler:
         self._record_cycle_stats(
             t0, t_prelude, candidates, started, _time.perf_counter(),
             "backfill" if self.config.backfill else solver_name)
+        return started
+
+    def _immediate_solve(self, avail, total, alive, cost0, jobs_batch,
+                         max_nodes):
+        """Route one immediate-fit solve through the configured backend
+        (auto/native/device/pallas/sharded — all bit-identical)."""
+        placements = None
+        solver_name = "immediate"
+        if self.config.solver in ("auto", "native"):
+            placements = self._solve_native(avail, total, alive, cost0,
+                                            jobs_batch, max_nodes)
+            if placements is not None:
+                solver_name = "native"
+            elif self.config.solver == "native":
+                raise RuntimeError("native solver unavailable")
+        if placements is None and self.config.solver == "sharded":
+            placements = self._solve_sharded(avail, total, alive, cost0,
+                                             jobs_batch, max_nodes)
+            solver_name = "sharded"
+        if placements is None and self.config.solver == "pallas":
+            placements = self._solve_pallas(avail, total, alive, cost0,
+                                            jobs_batch, max_nodes)
+            solver_name = "pallas"
+        if placements is None:
+            state = make_cluster_state(avail, total, alive, cost0)
+            placements, _ = solve_greedy(state, jobs_batch,
+                                         max_nodes=max_nodes)
+        return placements, solver_name
+
+    def _split_backfill_cycle(self, ordered, jobs_batch, avail, total,
+                              alive, cost0, max_nodes, now
+                              ) -> list[int]:
+        """Bounded backfill lookahead (Slurm's sched/bf split): the
+        timed solve with full reservation semantics covers only the top
+        ``backfill_max_jobs`` priority jobs; the tail is placed by the
+        fast immediate solver against the MIN-over-horizon availability
+        of the post-reservation time map, so no tail placement can ever
+        violate a head reservation (it fits even the tightest bucket —
+        strictly conservative, like the rest of the grid design)."""
+        bf_max = max(1, self.config.backfill_max_jobs)
+        head, tail = ordered[:bf_max], ordered[bf_max:]
+
+        # slice the already-built batch — rebuilding it would pay the
+        # dense [J, N] part_mask twice per cycle in exactly the regime
+        # this split exists to keep fast.  The bucketed head keeps the
+        # jit cache small; the tail reuses the full batch rows with the
+        # head rows invalidated (padding-style no-ops).
+        import jax
+
+        hb = self._bucket(len(head))
+        head_batch = jax.tree.map(lambda x: x[:hb], jobs_batch)
+        # rows past len(head) in the bucketed slice are REAL tail jobs —
+        # invalidate them or they would place in both passes
+        head_batch = head_batch.replace(valid=head_batch.valid & (
+            jnp.arange(hb) < len(head)))
+        tail_valid = jobs_batch.valid & (
+            jnp.arange(jobs_batch.valid.shape[0]) >= bf_max)
+        tail_batch = jobs_batch.replace(valid=tail_valid)
+
+        state = self._timed_state(now, avail, total, alive, cost0)
+        placements, tstate = solve_backfill(
+            state, self._timed_batch(head_batch, head),
+            max_nodes=max_nodes)
+        started = self._commit(head, placements, now,
+                               np.asarray(placements.start_bucket))
+
+        # pass 2: the tail against the tightest bucket of the horizon
+        min_avail = np.asarray(jnp.min(tstate.time_avail, axis=1))
+        cost1 = np.asarray(tstate.cost)
+        self.meta.start_logging()   # fresh event window for this commit
+        placements2, _ = self._immediate_solve(
+            min_avail, total, alive, cost1, tail_batch, max_nodes)
+        tail_placements = Placements(
+            placed=placements2.placed[bf_max:],
+            nodes=placements2.nodes[bf_max:],
+            reason=placements2.reason[bf_max:])
+        started += self._commit(tail, tail_placements, now)
         return started
 
     def _record_cycle_stats(self, t0, t_prelude, candidates, started,
@@ -1296,6 +1492,63 @@ class JobScheduler:
         shim = _Shim()
         shim.placed, shim.nodes, shim.reason = out[0], out[1], out[2]
         return shim
+
+    def _solve_sharded(self, avail, total, alive, cost0, jobs_batch,
+                       max_nodes):
+        """Node-axis-sharded multi-chip solve (parallel/sharded.py):
+        cluster tensors are sharded over every visible device, the
+        per-job candidate merge rides ICI all_gathers.  Bit-identical
+        placements to solve_greedy (tests/test_sharded_parity.py);
+        the multichip dryrun asserts the same through this exact path."""
+        import jax as _jax
+
+        from cranesched_tpu.parallel.sharded import (
+            make_node_mesh,
+            shard_cluster_state,
+            solve_greedy_sharded,
+        )
+
+        if self._mesh is None:
+            self._mesh = make_node_mesh()
+        mesh = self._mesh
+        d = mesh.devices.size
+        n = avail.shape[0]
+        pad = (-n) % d
+        if pad:
+            # pad with permanently-dead nodes so the node axis divides
+            # the mesh; they are never eligible, so placements and the
+            # trailing ledger rows are unaffected
+            zrow = np.zeros((pad, avail.shape[1]), avail.dtype)
+            avail = np.concatenate([avail, zrow])
+            total = np.concatenate([total, zrow])
+            alive = np.concatenate([alive, np.zeros(pad, bool)])
+            cost0 = np.concatenate(
+                [cost0, np.zeros(pad, cost0.dtype)])
+            jobs_batch = jobs_batch.replace(part_mask=jnp.pad(
+                jobs_batch.part_mask, ((0, 0), (0, pad)),
+                constant_values=False))
+        state = make_cluster_state(avail, total, alive, cost0)
+        state = shard_cluster_state(state, mesh)
+        placements, _ = solve_greedy_sharded(state, jobs_batch, mesh,
+                                             max_nodes=max_nodes)
+        return placements
+
+    def _solve_pallas(self, avail, total, alive, cost0, jobs_batch,
+                      max_nodes):
+        """Single-kernel TPU solve (models/pallas_solver.py).  Eligibility
+        classes are rebuilt host-side from the batch's mask rows; on
+        non-TPU backends the kernel runs in interpret mode (tests)."""
+        import jax as _jax
+
+        from cranesched_tpu.models.pallas_solver import (
+            solve_greedy_pallas_from_batch,
+        )
+
+        state = make_cluster_state(avail, total, alive, cost0)
+        placements, _ = solve_greedy_pallas_from_batch(
+            state, jobs_batch, max_nodes=max_nodes,
+            interpret=_jax.default_backend() != "tpu")
+        return placements
 
     def _initial_cost_reference(self, now: float,
                                 total: np.ndarray) -> np.ndarray:
@@ -1549,9 +1802,46 @@ class JobScheduler:
                            node=jnp.asarray(r_node),
                            alloc=jnp.asarray(r_alloc),
                            valid=jnp.asarray(r_valid))
-        decisions, _ = solve_preempt(
-            avail, total, alive, self._ledger.cost0(now, N),
-            vrows, batch, num_victims=V, max_nodes=max_nodes)
+        start_buckets = None
+        if self.config.backfill:
+            # time-axis what-if (models/preempt_time — the reference's
+            # PreemptSegTree capability): a preemptor may combine
+            # eviction with waiting for natural releases.  Victim rows
+            # carry their release bucket; decisions carry a start
+            # bucket: s == 0 starts now, s > 0 kills the victims now
+            # and leaves the preemptor pending (the next cycles' solve
+            # re-reserves its window against the freed resources).
+            from cranesched_tpu.models.preempt_time import (
+                TimedPreemptorBatch, TimedVictimRows,
+                solve_preempt_timed)
+
+            res = self.config.time_resolution
+            T = self.config.time_buckets
+            r_end = np.full(M, T + 1, np.int32)
+            for i, (vi, _n, _a) in enumerate(rows):
+                v = victims[vi]
+                remain = max((v.start_time or now)
+                             + v.spec.time_limit - now, 0.0)
+                r_end[i] = min(int(np.ceil(remain / res)), T + 1)
+            tstate = self._timed_state(now, avail, total, alive,
+                                       self._ledger.cost0(now, N))
+            tbatch = TimedPreemptorBatch(
+                req=batch.req, node_num=batch.node_num,
+                time_limit=batch.time_limit,
+                dur_buckets=jnp.asarray(np.clip(
+                    np.ceil(time_limit / res), 1, T).astype(np.int32)),
+                part_mask=batch.part_mask, exclusive=batch.exclusive,
+                can_prey=batch.can_prey, valid=batch.valid)
+            decisions, _ = solve_preempt_timed(
+                tstate.time_avail, total, alive, tstate.cost,
+                TimedVictimRows(rows=vrows,
+                                end_bucket=jnp.asarray(r_end)),
+                tbatch, num_victims=V, max_nodes=max_nodes)
+            start_buckets = np.asarray(decisions.start_bucket)
+        else:
+            decisions, _ = solve_preempt(
+                avail, total, alive, self._ledger.cost0(now, N),
+                vrows, batch, num_victims=V, max_nodes=max_nodes)
 
         placed = np.asarray(decisions.placed)
         nodes_mat = np.asarray(decisions.nodes)
@@ -1564,6 +1854,15 @@ class JobScheduler:
             evict_ids = [victims[vi].job_id
                          for vi in np.nonzero(evict_mat[i])[0]
                          if vi < len(victims)]
+            if start_buckets is not None and start_buckets[i] > 0:
+                # future-start preemption: kill only, start later.
+                # Without victims to kill there is nothing to commit —
+                # plain waiting is the backfill solver's job.
+                if evict_ids:
+                    for victim_id in evict_ids:
+                        self._evict(victim_id, now)
+                    job.pending_reason = PendingReason.PRIORITY
+                continue
             if self._commit_preemption(job, chosen, evict_ids,
                                        layouts[i], now):
                 started.append(job.job_id)

