@@ -471,6 +471,30 @@ def cmd_ccontrol(args) -> int:
         reply = client.suspend(args.job_id)
     elif args.action == "resume":
         reply = client.resume(args.job_id)
+    elif args.action == "modify":
+        # ccontrol modify JOBID time_limit=7200 priority=50
+        # partition=gpu  (reference ModifyJob / ccontrol update)
+        kw = {}
+        for kv in args.fields:
+            key, sep, value = kv.partition("=")
+            if not sep or key not in ("time_limit", "priority",
+                                      "partition"):
+                print(f"ccontrol: bad field {kv!r} (use time_limit=, "
+                      "priority=, partition=)", file=sys.stderr)
+                return 2
+            try:
+                kw[key] = (value if key == "partition"
+                           else float(value) if key == "time_limit"
+                           else int(value))
+            except ValueError:
+                print(f"ccontrol: bad value in {kv!r} "
+                      f"({key} must be a number)", file=sys.stderr)
+                return 2
+        if not kw:
+            print("ccontrol: modify needs at least one key=value",
+                  file=sys.stderr)
+            return 2
+        reply = client.modify_job(args.job_id, **kw)
     else:
         print(f"unknown action {args.action}", file=sys.stderr)
         return 2
@@ -662,10 +686,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("job_ids", nargs="+", type=int)
     p.set_defaults(func=cmd_ccancel)
 
-    p = sub.add_parser("ccontrol", help="hold/release/suspend/resume")
+    p = sub.add_parser("ccontrol",
+                       help="hold/release/suspend/resume/modify")
     p.add_argument("action",
-                   choices=["hold", "release", "suspend", "resume"])
+                   choices=["hold", "release", "suspend", "resume",
+                            "modify"])
     p.add_argument("job_id", type=int)
+    p.add_argument("fields", nargs="*", metavar="key=value",
+                   help="modify only: time_limit=SECONDS "
+                        "priority=N partition=NAME")
     p.set_defaults(func=cmd_ccontrol)
 
     p = sub.add_parser("cacct", help="show accounting history")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import enum
 import json
+import os
 import subprocess
 import sys
 import threading
@@ -63,13 +64,25 @@ class _Alloc:
 
 
 class _Step:
-    def __init__(self, job_id: int, proc: subprocess.Popen,
-                 incarnation: int = 0, step_id: int = 0):
+    def __init__(self, job_id: int, proc: subprocess.Popen | None,
+                 incarnation: int = 0, step_id: int = 0,
+                 control_path: str = "", report_path: str = "",
+                 pid: int | None = None):
         self.job_id = job_id
         self.step_id = step_id
-        self.proc = proc
+        self.proc = proc             # None for re-adopted supervisors
         self.incarnation = incarnation
         self.cancelled = False
+        # re-adoption surface (reference Craned.cpp:1345-1449): the
+        # FIFO takes control verbs when the stdin pipe died with a
+        # previous craned; the report file carries the terminal outcome
+        self.control_path = control_path
+        self.report_path = report_path
+        self.pid = pid if pid is not None else (
+            proc.pid if proc is not None else 0)
+        # /proc starttime ticks of the supervisor: disambiguates PID
+        # reuse across a craned restart (same pid, different process)
+        self.start_ticks: int | None = None
 
 
 class CranedDaemon:
@@ -137,6 +150,10 @@ class CranedDaemon:
         # kill.  A wildcard latch subsumes any guarded one.
         self._spawning: dict[tuple[int, int], int] = {}
         self._pending_kills: dict[tuple[int, int], int | None] = {}
+        # job_id -> (new time limit, incarnation) latched when a
+        # ChangeTimeLimit beats the supervisor spawn (same race shape
+        # as _pending_kills); applied at spawn registration
+        self._pending_limits: dict[int, tuple[float, int]] = {}
         # same race shape at the allocation level: a FreeJob that
         # arrives while an AllocJob is still in flight must latch so
         # the late allocation is torn down, not leaked
@@ -144,6 +161,15 @@ class CranedDaemon:
         self._pending_frees: dict[int, int | None] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
+        self._crashed = False   # crash-simulation flag (stop graceful=False)
+        # durable step registry (reference Craned.cpp:1345-1449): a
+        # restarted craned re-adopts live supervisors from here instead
+        # of orphaning them.  Per-craned-name dir so colocated test
+        # daemons never cross-adopt.
+        self._steps_dir = os.path.join(workdir, f".crane_steps_{name}")
+        os.makedirs(self._steps_dir, exist_ok=True)
+        self._registry_path = os.path.join(self._steps_dir,
+                                           "registry.json")
         self._stop = threading.Event()
         self.address = ""
 
@@ -242,6 +268,9 @@ class CranedDaemon:
 
     def _free_job(self, job_id: int, guard: int | None) -> None:
         with self._lock:
+            # a latched time-limit update dies with the job (spawn
+            # failures would otherwise leak the entry)
+            self._pending_limits.pop(job_id, None)
             alloc = self._allocs.get(job_id)
             if alloc is None:
                 # maybe the AllocJob is still in flight: latch the free
@@ -273,6 +302,37 @@ class CranedDaemon:
             self._send_verb(step, "TERM")
         self._maybe_teardown_alloc(job_id)
 
+    def ChangeTimeLimit(self, request, context):
+        """Propagate a new job deadline to the batch supervisor
+        (reference ChangeJobTimeConstraint, CranedServer.cpp handler):
+        the LIMIT verb rebases the supervisor's deadline to the given
+        total seconds from step start.  Only step 0 carries the JOB
+        time limit; other steps keep their own StepSpec limits.
+
+        The update can arrive BEFORE the supervisor registers (ctld
+        marks the job Running at dispatch; ExecuteStep and this RPC ride
+        separate workers) — latch it and apply at spawn registration, or
+        the modified deadline would be silently lost to the race."""
+        with self._lock:
+            step = self._steps.get((request.job_id, 0))
+            if (step is not None and request.incarnation
+                    and step.incarnation != request.incarnation):
+                step = None
+            if step is None:
+                # latch ONLY while the spawn is actually in flight
+                # (mirrors _pending_kills); a limit for a step that is
+                # neither registered nor spawning has nothing to attach
+                # to — refusing keeps the latch map bounded, and the
+                # ctld's spec carries the new limit to any future
+                # incarnation's init anyway
+                if (request.job_id, 0) in self._spawning:
+                    self._pending_limits[request.job_id] = (
+                        request.time_limit, request.incarnation)
+                    return pb.OkReply(ok=True)
+                return pb.OkReply(ok=False, error="no such step")
+        self._send_verb(step, f"LIMIT {request.time_limit}")
+        return pb.OkReply(ok=True)
+
     def SuspendStep(self, request, context):
         return self._freeze(request.job_id, True)
 
@@ -298,6 +358,21 @@ class CranedDaemon:
         return pb.OkReply(ok=True)
 
     def _send_verb(self, step: _Step, verb: str) -> None:
+        if step.proc is None:
+            # re-adopted supervisor: the stdin pipe died with the old
+            # craned; verbs travel over the FIFO instead
+            if not step.control_path:
+                return
+            try:
+                fd = os.open(step.control_path,
+                             os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, f"{verb}\n".encode())
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+            return
         try:
             step.proc.stdin.write(f"{verb}\n".encode())
             step.proc.stdin.flush()
@@ -424,6 +499,20 @@ class CranedDaemon:
                          else spec.interactive_token) or "")
         use_pty = bool((step_spec.pty if step_spec else False)
                        or spec.pty)
+        base = os.path.join(
+            self._steps_dir,
+            f"j{job_id}_s{step_id}_i{request.incarnation}")
+        control_path = base + ".ctl"
+        report_path = base + ".rpt"
+        for stale in (control_path, report_path):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        try:
+            os.mkfifo(control_path)
+        except OSError:
+            control_path = ""   # no FIFO support: stdin verbs only
         init = dict(
             job_id=job_id, step_id=step_id, script=script,
             output_path=output_path,
@@ -431,7 +520,8 @@ class CranedDaemon:
             env=step_env,
             cfored=cfored, cfored_token=cfored_token, pty=use_pty,
             prolog=self.prolog, epilog=self.epilog,
-            cgroup_procs=alloc.procs_path)
+            cgroup_procs=alloc.procs_path,
+            control_path=control_path, report_path=report_path)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()
@@ -454,7 +544,9 @@ class CranedDaemon:
                 pass
             raise
         step = _Step(job_id, proc, incarnation=request.incarnation,
-                     step_id=step_id)
+                     step_id=step_id, control_path=control_path,
+                     report_path=report_path)
+        step.start_ticks = self._proc_start_ticks(proc.pid)
         with self._lock:
             existing = self._steps.get(key)
             # a slow stale spawn must not clobber an already-registered
@@ -464,6 +556,7 @@ class CranedDaemon:
                           and existing.incarnation > request.incarnation)
             if not stale_self:
                 self._steps[key] = step
+                self._persist_registry_locked()
             if self._spawning.get(key) == request.incarnation:
                 self._spawning.pop(key, None)
             # consume a latched kill only if it was aimed at US (guarded
@@ -488,6 +581,14 @@ class CranedDaemon:
         if killed_already:
             step.cancelled = True
             self._send_verb(step, "TERM")
+        if step_id == 0 and not stale_self:
+            with self._lock:
+                latched = self._pending_limits.pop(job_id, None)
+                if (latched is not None and latched[1]
+                        and latched[1] != request.incarnation):
+                    latched = None   # stale: meant for another run
+            if latched is not None:
+                self._send_verb(step, f"LIMIT {latched[0]}")
         threading.Thread(target=self._watch_step, args=(step,),
                          daemon=True).start()
 
@@ -531,6 +632,43 @@ class CranedDaemon:
         """SIGCHLD/reporting path (supervisor exit -> StepStatusChange)."""
         report = step.proc.stdout.readline().strip().decode()
         step.proc.wait()
+        if self._crashed:
+            # crash simulation only: a dead craned reports nothing and
+            # must leave the durable registry intact for the next
+            # incarnation to recover.  A GRACEFUL stop still reports
+            # every step's terminal outcome.
+            return
+        self._finish_step(step, report)
+
+    def _watch_adopted(self, step: _Step) -> None:
+        """Watcher for a re-adopted supervisor (not our child): poll the
+        durable report file and the pid until the outcome lands."""
+        while not self._crashed:
+            try:
+                with open(step.report_path) as fh:
+                    report = fh.read().strip()
+                self._finish_step(step, report)
+                return
+            except OSError:
+                pass
+            if not self._pid_is_step(step):
+                # died without a report; grace for an in-flight rename
+                time.sleep(0.3)
+                try:
+                    with open(step.report_path) as fh:
+                        report = fh.read().strip()
+                except OSError:
+                    report = ""
+                if not self._crashed:
+                    self._finish_step(step, report)
+                return
+            time.sleep(0.2)
+
+    def _finish_step(self, step: _Step, report: str) -> None:
+        # recovery can finish steps before registration completed; the
+        # status change needs a node identity to be aggregated per-node
+        while self.node_id is None and not self._stop.is_set():
+            time.sleep(0.1)
         key = (step.job_id, step.step_id)
         with self._lock:
             # only clean up if the registry still points at OUR step — a
@@ -538,6 +676,13 @@ class CranedDaemon:
             mine = self._steps.get(key) is step
             if mine:
                 self._steps.pop(key, None)
+                self._persist_registry_locked()
+        for path in (step.control_path, step.report_path):
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         if mine:
             # implicit allocations die with their last step; explicit
             # ones wait for FreeJob (their GRES/cgroup belong to the
@@ -587,6 +732,105 @@ class CranedDaemon:
             pass  # ctld down / client closed: the ping timeout + WAL
                   # reconcile at re-registration
 
+    # ---- durable step registry + re-adoption ----
+
+    @staticmethod
+    def _proc_start_ticks(pid: int) -> int | None:
+        """The process's starttime (clock ticks since boot, stat field
+        22) — the standard PID-reuse disambiguator."""
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as fh:
+                data = fh.read().decode("latin-1")
+            rest = data.rsplit(")", 1)[1].split()
+            return int(rest[19])
+        except (OSError, IndexError, ValueError):
+            return None
+
+    def _pid_is_step(self, step: _Step) -> bool:
+        """Is step.pid alive AND the same process we recorded?  A
+        recycled pid (or an EPERM from someone else's process with that
+        pid) must read as dead, not as our supervisor."""
+        if step.pid <= 0:
+            return False
+        try:
+            os.kill(step.pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return False   # someone else's process: not our supervisor
+        except OSError:
+            return False
+        if step.start_ticks is None:
+            return True    # pre-upgrade registry row: best effort
+        return self._proc_start_ticks(step.pid) == step.start_ticks
+
+    def _persist_registry_locked(self) -> None:
+        """Rewrite the registry to match self._steps (caller holds the
+        lock).  Tiny file, atomic rename — a torn write can never be
+        loaded."""
+        rows = [dict(job_id=s.job_id, step_id=s.step_id,
+                     incarnation=s.incarnation, pid=s.pid,
+                     start_ticks=s.start_ticks,
+                     control=s.control_path, report=s.report_path,
+                     cancelled=s.cancelled)
+                for s in self._steps.values()]
+        tmp = self._registry_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(rows, fh)
+            os.replace(tmp, self._registry_path)
+        except OSError:
+            pass
+
+    def _recover_steps(self) -> None:
+        """Re-adopt supervisors that survived a craned restart
+        (reference Craned.cpp:1345-1449): live pids get an adopted
+        watcher (control via FIFO); finished ones report their durable
+        outcome; vanished ones report Failed.  Runs BEFORE registration
+        so the re-register reconcile sees these steps as present."""
+        try:
+            with open(self._registry_path, encoding="utf-8") as fh:
+                rows = json.load(fh)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return
+        finished = []
+        for row in rows:
+            step = _Step(int(row["job_id"]), None,
+                         incarnation=int(row.get("incarnation", 0)),
+                         step_id=int(row.get("step_id", 0)),
+                         control_path=row.get("control", ""),
+                         report_path=row.get("report", ""),
+                         pid=int(row.get("pid", 0)))
+            step.cancelled = bool(row.get("cancelled", False))
+            ticks = row.get("start_ticks")
+            step.start_ticks = int(ticks) if ticks is not None else None
+            alive = self._pid_is_step(step)
+            has_report = step.report_path and os.path.exists(
+                step.report_path)
+            if alive or has_report:
+                with self._lock:
+                    self._steps[(step.job_id, step.step_id)] = step
+                if alive and not has_report:
+                    threading.Thread(target=self._watch_adopted,
+                                     args=(step,), daemon=True).start()
+                else:
+                    finished.append(step)
+            else:
+                # no pid, no report: the outcome is unrecoverable
+                finished.append(step)
+        with self._lock:
+            self._persist_registry_locked()
+        for step in finished:
+            report = ""
+            if step.report_path:
+                try:
+                    with open(step.report_path) as fh:
+                        report = fh.read().strip()
+                except OSError:
+                    report = ""
+            threading.Thread(target=self._finish_step,
+                             args=(step, report), daemon=True).start()
+
     # ---- lifecycle: serve + register + ping ----
 
     _RPCS = {
@@ -596,6 +840,7 @@ class CranedDaemon:
         "FreeJob": (pb.JobIdRequest, pb.OkReply),
         "SuspendStep": (pb.JobIdRequest, pb.OkReply),
         "ResumeStep": (pb.JobIdRequest, pb.OkReply),
+        "ChangeTimeLimit": (pb.TimeLimitRequest, pb.OkReply),
     }
 
     def start(self, address: str = "127.0.0.1:0") -> int:
@@ -614,6 +859,10 @@ class CranedDaemon:
         port = self._server.add_insecure_port(address)
         self._server.start()
         self.address = f"127.0.0.1:{port}"
+        # recovery BEFORE the registration FSM: re-adopted steps must be
+        # in the registry when the re-register reconcile runs, or the
+        # expectations exchange would treat them as dead
+        self._recover_steps()
         threading.Thread(target=self._fsm_loop, daemon=True).start()
         if self.health_program:
             threading.Thread(target=self._health_loop,
@@ -708,18 +957,24 @@ class CranedDaemon:
             if not ok:
                 self.state = CranedState.DISCONNECTED
 
-    def stop(self, graceful: bool = True) -> None:
+    def stop(self, graceful: bool = True,
+             orphan_supervisors: bool = False) -> None:
         """graceful=False mimics a node crash: no kills, no reports —
-        ctld must detect the death via missed pings."""
+        ctld must detect the death via missed pings.
+        orphan_supervisors leaves the supervisor processes RUNNING (the
+        realistic craned-crash shape: supervisors are separate
+        processes), so a new daemon on the same workdir can re-adopt
+        them."""
         self._stop.set()
         if not graceful:
+            self._crashed = True
             self._ctld.close()   # closed first: no report can escape
         with self._lock:
             steps = list(self._steps.values())
         for step in steps:
             if graceful:
                 self._send_verb(step, "TERM")
-            else:
+            elif step.proc is not None and not orphan_supervisors:
                 step.proc.kill()  # crash simulation: the user workload
                                   # is deliberately orphaned
         if self._server is not None:

@@ -220,6 +220,20 @@ def main() -> int:
     if go != "GO":
         return 1
 
+    def report(line: str) -> None:
+        """Terminal report: the stdout line feeds the live craned's
+        watcher; the report FILE (atomic rename) survives a craned
+        restart so a new incarnation can recover the outcome."""
+        rp = init.get("report_path") or ""
+        if rp:
+            try:
+                with open(rp + ".tmp", "w") as fh:
+                    fh.write(line + "\n")
+                os.replace(rp + ".tmp", rp)
+            except OSError:
+                pass
+        print(line, flush=True)
+
     out = None
     if interactive is None:
         out_path = _substitute(init.get("output_path") or "/dev/null",
@@ -237,7 +251,7 @@ def main() -> int:
     if prolog:
         rc = _run_hook(prolog, env, out)
         if rc != 0:
-            print(f"PROLOGFAIL {rc}", flush=True)
+            report(f"PROLOGFAIL {rc}")
             return 0
 
     if interactive is not None:
@@ -257,34 +271,63 @@ def main() -> int:
             pass  # cgroupfs unavailable: resource limits best-effort
 
     state = {"suspended_at": None, "suspended_total": 0.0,
-             "terminated": False}
+             "terminated": False, "time_limit": time_limit}
     start = time.monotonic()
+
+    def handle_verb(verb: str) -> None:
+        try:
+            if verb == "TERM":
+                state["terminated"] = True
+                os.killpg(child.pid, signal.SIGTERM)
+                escalate = threading.Timer(
+                    5.0, lambda: child.poll() is None
+                    and os.killpg(child.pid, signal.SIGKILL))
+                escalate.daemon = True  # never delays supervisor exit
+                escalate.start()
+            elif verb == "STOP":
+                os.killpg(child.pid, signal.SIGSTOP)
+                state["suspended_at"] = time.monotonic()
+            elif verb == "CONT":
+                if state["suspended_at"] is not None:
+                    state["suspended_total"] += (
+                        time.monotonic() - state["suspended_at"])
+                    state["suspended_at"] = None
+                os.killpg(child.pid, signal.SIGCONT)
+            elif verb.startswith("LIMIT "):
+                # deadline update (ccontrol modify time_limit; the
+                # ChangeJobTimeConstraint analog): total seconds
+                # from step start, 0 = unlimited
+                try:
+                    new_limit = float(verb.split(None, 1)[1])
+                except ValueError:
+                    return
+                state["time_limit"] = new_limit or None
+        except ProcessLookupError:
+            pass
 
     def control_loop():
         for line in sys.stdin:
-            verb = line.strip()
-            try:
-                if verb == "TERM":
-                    state["terminated"] = True
-                    os.killpg(child.pid, signal.SIGTERM)
-                    escalate = threading.Timer(
-                        5.0, lambda: child.poll() is None
-                        and os.killpg(child.pid, signal.SIGKILL))
-                    escalate.daemon = True  # never delays supervisor exit
-                    escalate.start()
-                elif verb == "STOP":
-                    os.killpg(child.pid, signal.SIGSTOP)
-                    state["suspended_at"] = time.monotonic()
-                elif verb == "CONT":
-                    if state["suspended_at"] is not None:
-                        state["suspended_total"] += (
-                            time.monotonic() - state["suspended_at"])
-                        state["suspended_at"] = None
-                    os.killpg(child.pid, signal.SIGCONT)
-            except ProcessLookupError:
-                return
+            handle_verb(line.strip())
 
     threading.Thread(target=control_loop, daemon=True).start()
+
+    # second control channel for craned-restart re-adoption (reference
+    # Craned.cpp:1345-1449 reconnects supervisors): the stdin pipe dies
+    # with the craned process, so verbs can also arrive over a FIFO
+    # that any future craned incarnation can open by path.  O_RDWR
+    # keeps a writer open so reads block instead of seeing EOF.
+    control_path = init.get("control_path") or ""
+    if control_path:
+        def fifo_loop():
+            try:
+                fd = os.open(control_path, os.O_RDWR)
+            except OSError:
+                return
+            with os.fdopen(fd, "r") as fh:
+                for line in fh:
+                    handle_verb(line.strip())
+
+        threading.Thread(target=fifo_loop, daemon=True).start()
 
     while True:
         try:
@@ -292,10 +335,11 @@ def main() -> int:
             break
         except subprocess.TimeoutExpired:
             pass
-        if time_limit is None or state["suspended_at"] is not None:
+        limit = state["time_limit"]
+        if limit is None or state["suspended_at"] is not None:
             continue
         elapsed = (time.monotonic() - start) - state["suspended_total"]
-        if elapsed > time_limit:
+        if elapsed > limit:
             try:
                 os.killpg(child.pid, signal.SIGKILL)
             except ProcessLookupError:
@@ -307,7 +351,7 @@ def main() -> int:
             if init.get("epilog"):
                 if _run_hook(init["epilog"], env, out) != 0:
                     suffix = " EPILOGFAIL"
-            print("TIMEOUT" + suffix, flush=True)
+            report("TIMEOUT" + suffix)
             return 0
 
     if interactive is not None:
@@ -325,9 +369,9 @@ def main() -> int:
             epilog_suffix = " EPILOGFAIL"
 
     if state["terminated"]:
-        print("KILLED" + epilog_suffix, flush=True)
+        report("KILLED" + epilog_suffix)
     else:
-        print(f"EXIT {code}{epilog_suffix}", flush=True)
+        report(f"EXIT {code}{epilog_suffix}")
     return 0
 
 

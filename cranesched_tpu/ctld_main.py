@@ -54,6 +54,12 @@ def main(argv=None) -> int:
     cfg = load_config(args.config)
     meta, scheduler = cfg.build()
 
+    if cfg.acct_store_path and scheduler.accounts is not None:
+        print(f"accounting store: {cfg.acct_store_path} "
+              f"({len(scheduler.accounts.accounts)} accounts, "
+              f"{len(scheduler.accounts.users)} users, "
+              f"{len(scheduler.accounts.qos)} qos)", flush=True)
+
     if cfg.archive_path:
         from cranesched_tpu.ctld.archive import JobArchive
         os.makedirs(os.path.dirname(cfg.archive_path) or ".",

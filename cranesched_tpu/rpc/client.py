@@ -43,6 +43,18 @@ class CtldClient:
                           pb.HoldRequest(job_id=job_id, held=held),
                           pb.OkReply)
 
+    def modify_job(self, job_id: int, time_limit: float | None = None,
+                   priority: int | None = None,
+                   partition: str | None = None) -> pb.OkReply:
+        req = pb.ModifyJobRequest(job_id=job_id)
+        if time_limit is not None:
+            req.time_limit = time_limit
+        if priority is not None:
+            req.priority = priority
+        if partition is not None:
+            req.partition = partition
+        return self._call("ModifyJob", req, pb.OkReply)
+
     def suspend(self, job_id: int) -> pb.OkReply:
         return self._call("SuspendJob", pb.JobIdRequest(job_id=job_id),
                           pb.OkReply)

@@ -96,7 +96,9 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         ntasks=spec.ntasks or 0,
         ntasks_per_node_min=spec.ntasks_per_node_min,
         ntasks_per_node_max=spec.ntasks_per_node_max,
-        exclusive=spec.exclusive, time_limit=spec.time_limit,
+        # host-side limits are float seconds; the wire field is uint32
+        # (a float here raises TypeError inside a dispatch thread)
+        exclusive=spec.exclusive, time_limit=int(spec.time_limit),
         qos=spec.qos, qos_priority=spec.qos_priority, held=spec.held,
         include_nodes=list(spec.include_nodes),
         exclude_nodes=list(spec.exclude_nodes),
@@ -143,7 +145,7 @@ def step_spec_from_pb(msg) -> StepSpec:
 def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
     msg = pb.StepSpec(name=spec.name, script=spec.script,
                       node_num=spec.node_num,
-                      time_limit=spec.time_limit,
+                      time_limit=int(spec.time_limit),
                       output_path=spec.output_path,
                       interactive_address=spec.interactive_address,
                       pty=spec.pty,

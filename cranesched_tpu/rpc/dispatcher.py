@@ -58,6 +58,7 @@ class GrpcDispatcher:
         scheduler.dispatch_free_alloc = self.free_alloc
         scheduler.dispatch_suspend = self.suspend
         scheduler.dispatch_resume = self.resume
+        scheduler.dispatch_change_time_limit = self.change_time_limit
 
     def node_registered(self, node_id: int, address: str) -> None:
         with self._lock:
@@ -284,6 +285,41 @@ class GrpcDispatcher:
             self._try_call(n, "ResumeStep",
                            pb.JobIdRequest(job_id=job_id))
             for n in nodes])
+
+    def change_time_limit(self, job_id: int, time_limit: float,
+                          now: float) -> None:
+        """Push a modified deadline to the job's batch supervisors
+        (reference ChangeJobTimeConstraint, Crane.proto:1654).  The push
+        can beat the supervisor spawn (the craned then answers
+        ok=False), so the scheduler renews the intent each cycle; once
+        EVERY node accepts, the intent is popped here."""
+        job = self.scheduler.running.get(job_id)
+        if job is None:
+            return
+        nodes = list(job.node_ids)
+        incarnation = job.requeue_count
+        request = pb.TimeLimitRequest(job_id=job_id,
+                                      time_limit=time_limit,
+                                      incarnation=incarnation)
+
+        def push():
+            all_ok = True
+            for n in nodes:
+                stub = self._stub(n)
+                if stub is None:
+                    all_ok = False
+                    continue
+                try:
+                    reply = stub.call("ChangeTimeLimit", request)
+                    all_ok &= bool(reply.ok)
+                except grpc.RpcError:
+                    all_ok = False
+            if all_ok:
+                # racy-but-benign pop: a concurrent renewal just sends
+                # one extra idempotent update
+                self.scheduler._limit_intents.pop(job_id, None)
+
+        self._pool.submit(push)
 
     def _job_nodes(self, job_id: int) -> list[int]:
         job = self.scheduler.running.get(job_id)

@@ -221,6 +221,40 @@ class CtldServer:
                                      now=self._now())
         return pb.OkReply(ok=ok, error="" if ok else "not pending")
 
+    def ModifyJob(self, request, context):
+        """Job modification (reference ModifyJob, Crane.proto:1447).
+        Owner-or-admin; two refinements mirroring the reference's
+        operator gating: only an admin may RAISE a time limit (owners
+        may lower their own), and priority changes are admin-only."""
+        with self._lock:
+            ident = self._ident(context)
+            deny = self._deny_job_mutation(ident, request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
+            time_limit = (request.time_limit
+                          if request.HasField("time_limit") else None)
+            priority = (request.priority
+                        if request.HasField("priority") else None)
+            partition = (request.partition
+                         if request.HasField("partition") else None)
+            if self.auth is not None and not self.auth.is_admin(ident):
+                if priority is not None:
+                    return pb.OkReply(
+                        ok=False,
+                        error="permission denied (priority changes "
+                              "require admin)")
+                job = self.scheduler.job_info(request.job_id)
+                if (time_limit is not None and job is not None
+                        and time_limit > job.spec.time_limit):
+                    return pb.OkReply(
+                        ok=False,
+                        error="permission denied (raising a time "
+                              "limit requires admin)")
+            err = self.scheduler.modify_job(
+                request.job_id, now=self._now(), time_limit=time_limit,
+                priority=priority, partition=partition)
+        return pb.OkReply(ok=not err, error=err)
+
     def SuspendJob(self, request, context):
         with self._lock:
             deny = self._deny_job_mutation(self._ident(context),
@@ -664,6 +698,7 @@ class CtldServer:
         "SubmitBatchJobs": (pb.SubmitJobsRequest, pb.SubmitJobsReply),
         "CancelJob": (pb.JobIdRequest, pb.OkReply),
         "HoldJob": (pb.HoldRequest, pb.OkReply),
+        "ModifyJob": (pb.ModifyJobRequest, pb.OkReply),
         "SuspendJob": (pb.JobIdRequest, pb.OkReply),
         "ResumeJob": (pb.JobIdRequest, pb.OkReply),
         "QueryJobsInfo": (pb.QueryJobsRequest, pb.QueryJobsReply),

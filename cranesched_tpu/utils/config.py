@@ -69,6 +69,10 @@ class CraneConfig:
     # durable history (sqlite; the reference's MongoDB role) — empty =
     # RAM-only history that dies with the process
     archive_path: str = ""
+    # durable accounting hierarchy + txn log (sqlite; the reference's
+    # user/account/qos MongoDB collections, DbClient.h:87-724) — empty =
+    # RAM-only accounting that dies with the process
+    acct_store_path: str = ""
     nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
     partitions: list[PartitionConfig] = dataclasses.field(
         default_factory=list)
@@ -144,18 +148,41 @@ class CraneConfig:
             time_resolution=float(sc.get("TimeResolutionSec", 60)),
             time_buckets=int(sc.get("TimeBuckets", 64)),
             craned_timeout=float(sc.get("CranedTimeoutSec", 30)),
-            preempt_mode=str(sc.get("PreemptMode", "off")).lower())
+            preempt_mode=str(sc.get("PreemptMode", "off")).lower(),
+            solver=str(sc.get("Solver", "auto")).lower())
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)
         accounts = None
-        if self.accounting_root_users:
+        if self.accounting_root_users or self.acct_store_path:
             from cranesched_tpu.ctld.accounting import (
                 AccountManager, AdminLevel, User)
             accounts = AccountManager()
             for name in self.accounting_root_users:
                 accounts.users[str(name)] = User(
                     name=str(name), admin_level=AdminLevel.ROOT)
+            if self.acct_store_path:
+                # restore the persisted hierarchy BEFORE any WAL replay
+                # so recovered jobs can re-take QoS usage against it
+                import os as _os
+
+                from cranesched_tpu.ctld.acct_store import (
+                    AccountStore, attach_store)
+                _os.makedirs(_os.path.dirname(self.acct_store_path)
+                             or ".", exist_ok=True)
+                attach_store(accounts, AccountStore(self.acct_store_path))
+                # config-declared root users always keep ROOT: a stored
+                # plain-user record must not demote the only admins and
+                # lock operators out at boot (admin_level can only be
+                # fixed BY an admin)
+                for name in self.accounting_root_users:
+                    rec = accounts.users.get(str(name))
+                    if rec is None:
+                        accounts.users[str(name)] = User(
+                            name=str(name),
+                            admin_level=AdminLevel.ROOT)
+                    elif rec.admin_level < AdminLevel.ROOT:
+                        rec.admin_level = AdminLevel.ROOT
         scheduler = JobScheduler(meta, config, submit_hook=hook,
                                  accounts=accounts)
         for lic in self.licenses:
@@ -234,6 +261,8 @@ def load_config(path: str) -> CraneConfig:
         listen=str(raw.get("Listen", "127.0.0.1:50051")),
         wal_path=str(raw.get("Wal", "") or ""),
         archive_path=str(raw.get("Archive", "") or ""),
+        acct_store_path=str(
+            (raw.get("Accounting") or {}).get("Store", "") or ""),
         nodes=nodes,
         partitions=partitions,
         scheduler=raw.get("Scheduler", {}) or {},

@@ -1,0 +1,141 @@
+"""Durable accounting: the hierarchy, QoS, and txn log survive kill -9.
+
+Reference persists users/accounts/qos + the Txn audit log in MongoDB
+(DbClient.h:87-724) and rebuilds AccountManager on boot; VERDICT r3 #3's
+acceptance bar: kill -9 ctld, restart, `cacctmgr list` identical and run
+limits still enforced against recovered usage.
+"""
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.accounting import (
+    Account,
+    AccountManager,
+    AdminLevel,
+    Qos,
+    User,
+)
+from cranesched_tpu.ctld.acct_store import AccountStore, attach_store
+from cranesched_tpu.ctld.wal import WriteAheadLog
+
+
+def _seed(mgr):
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="normal", priority=100,
+                            max_jobs_per_user=1,
+                            max_tres_per_user=np.asarray(
+                                [16 * 256, 1 << 20, 1 << 20], np.int64)))
+    mgr.add_account("root", Account(name="hpc", allowed_qos={"normal"},
+                                    default_qos="normal"))
+    mgr.add_account("root", Account(name="hpc-sub", parent="hpc"))
+    mgr.add_user("root", User(name="alice", uid=1001), "hpc")
+    mgr.accounts["hpc"].coordinators.add("alice")
+    mgr.block_user("root", "alice", "hpc", blocked=False)
+
+
+def _fresh_manager(path):
+    """Simulated post-crash boot: a brand-new manager restored from the
+    store (no close() on the old one — kill -9 semantics)."""
+    mgr = AccountManager()
+    attach_store(mgr, AccountStore(path))
+    return mgr
+
+
+def test_hierarchy_and_txn_log_survive_restart(tmp_path):
+    path = str(tmp_path / "acct.sqlite")
+    m1 = AccountManager()
+    attach_store(m1, AccountStore(path))
+    _seed(m1)
+
+    m2 = _fresh_manager(path)
+    assert set(m2.qos) == set(m1.qos)
+    assert set(m2.accounts) == set(m1.accounts)
+    assert set(m2.users) == set(m1.users)
+    assert m2.users["root"].admin_level == AdminLevel.ROOT
+    q1, q2 = m1.qos["normal"], m2.qos["normal"]
+    assert q2.max_jobs_per_user == q1.max_jobs_per_user
+    np.testing.assert_array_equal(q2.max_tres_per_user,
+                                  q1.max_tres_per_user)
+    assert q2.reference_count == q1.reference_count
+    assert m2.accounts["hpc-sub"].parent == "hpc"
+    assert "alice" in m2.accounts["hpc"].coordinators
+    assert m2.users["alice"].accounts["hpc"].blocked is False
+    # the audit log is part of the durable surface (QueryTxnLog analog)
+    assert m2.txn_log == m1.txn_log
+    assert any(t["action"] == "add_qos" for t in m2.txn_log)
+
+
+def test_mutations_after_restart_keep_persisting(tmp_path):
+    path = str(tmp_path / "acct.sqlite")
+    m1 = AccountManager()
+    attach_store(m1, AccountStore(path))
+    _seed(m1)
+    m2 = _fresh_manager(path)
+    m2.add_user("root", User(name="bob", uid=1002), "hpc")
+    m3 = _fresh_manager(path)
+    assert "bob" in m3.users
+    assert "bob" in m3.accounts["hpc"].users
+
+
+def test_run_limits_enforced_after_crash_with_live_usage(tmp_path):
+    """kill -9 with one running job holding a MaxJobsPerUser=1 slot:
+    after restart (store load -> WAL replay -> recover), the second job
+    must still be refused the run slot."""
+    acct_path = str(tmp_path / "acct.sqlite")
+    wal_path = str(tmp_path / "ctld.wal")
+
+    def build(recovered=None):
+        mgr = AccountManager()
+        attach_store(mgr, AccountStore(acct_path))
+        if not mgr.accounts:          # first boot seeds the hierarchy
+            _seed(mgr)
+        meta = MetaContainer()
+        for i in range(4):
+            meta.add_node(f"cn{i}", meta.layout.encode(
+                cpu=8, mem_bytes=16 << 30, memsw_bytes=16 << 30,
+                is_capacity=True))
+            meta.craned_up(i)
+        sched = JobScheduler(meta, SchedulerConfig(backfill=False),
+                             accounts=mgr)
+        sim = SimCluster(sched)
+        sim.wire(sched)
+        if recovered:
+            sched.recover(recovered, now=100.0)
+        sched.wal = WriteAheadLog(wal_path)
+        return sched
+
+    s1 = build()
+    spec = JobSpec(user="alice", account="hpc",
+                   res=ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=1e9)
+    j1 = s1.submit(spec, now=0.0)
+    j2 = s1.submit(spec, now=0.1)
+    started = s1.schedule_cycle(now=1.0)
+    assert started == [j1]
+    assert s1.pending[j2].pending_reason == PendingReason.RESOURCE \
+        or s1.pending[j2].pending_reason is not None
+
+    # ---- kill -9: no close, rebuild everything from disk ----
+    replayed = WriteAheadLog.replay(wal_path)
+    s2 = build(recovered=replayed)
+    assert s2.running[j1].status == JobStatus.RUNNING
+    assert j2 in s2.pending
+    started = s2.schedule_cycle(now=101.0)
+    assert started == []              # MaxJobsPerUser=1 still held by j1
+    # freeing j1 releases the slot and j2 runs
+    s2.step_status_change(j1, JobStatus.COMPLETED, 0, 102.0)
+    s2.process_status_changes()
+    started = s2.schedule_cycle(now=103.0)
+    assert started == [j2]
