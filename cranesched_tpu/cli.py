@@ -87,9 +87,39 @@ def _token(args) -> str:
         return ""
 
 
+def _tls(args):
+    """--ca > $CRANE_CA > ~/.crane/ca.pem (absent = plaintext dial).
+
+    The dial pins the server identity to the NAME the control-plane
+    cert was issued under ($CRANE_TLS_NAME, default "ctld") — any
+    other cluster-issued cert, loopback SANs and all, is refused.
+    ``--cert``/``--key`` (or $CRANE_CERT/$CRANE_KEY, or
+    ~/.crane/cert.pem+key.pem) present this user's cert for
+    RequireClientCert (mTLS) clusters."""
+    ca = getattr(args, "ca", "") or os.environ.get("CRANE_CA", "")
+    if not ca:
+        default = os.path.expanduser("~/.crane/ca.pem")
+        if os.path.exists(default):
+            ca = default
+    if not ca:
+        return None
+    cert = (getattr(args, "cert", "")
+            or os.environ.get("CRANE_CERT", ""))
+    key = getattr(args, "key", "") or os.environ.get("CRANE_KEY", "")
+    if not cert:
+        dcert = os.path.expanduser("~/.crane/cert.pem")
+        dkey = os.path.expanduser("~/.crane/key.pem")
+        if os.path.exists(dcert) and os.path.exists(dkey):
+            cert, key = dcert, dkey
+    from cranesched_tpu.utils.pki import TlsConfig
+    return TlsConfig(
+        ca=ca, cert=cert, key=key,
+        override_authority=os.environ.get("CRANE_TLS_NAME", "ctld"))
+
+
 def _client(args):
     from cranesched_tpu.rpc.client import CtldClient
-    return CtldClient(args.server, token=_token(args))
+    return CtldClient(args.server, token=_token(args), tls=_tls(args))
 
 
 def cmd_ctoken(args) -> int:
@@ -376,7 +406,22 @@ def cmd_crun(args) -> int:
     allocation (reference crun within calloc)."""
     from cranesched_tpu.rpc.cfored import CforedServer
     client = _client(args)
-    cfored = CforedServer()
+    hub_tls = None
+    if args.io_cert or args.io_key:
+        if not (args.io_cert and args.io_key):
+            # half a keypair must not silently downgrade to plaintext
+            print("crun: --io-cert and --io-key go together",
+                  file=sys.stderr)
+            return 2
+        base = _tls(args)
+        if base is None:
+            print("crun: --io-cert needs a cluster CA (--ca)",
+                  file=sys.stderr)
+            return 2
+        import dataclasses as _dc
+        hub_tls = _dc.replace(base, cert=args.io_cert, key=args.io_key,
+                              override_authority="")
+    cfored = CforedServer(tls=hub_tls)
     cfored.start(host_for_clients=args.bind_host)
     try:
         if args.jobid:
@@ -569,6 +614,34 @@ def cmd_cresv(args) -> int:
     return 0
 
 
+def cmd_cpki(args) -> int:
+    """Cluster PKI admin (the VaultClient role, VaultClient.h:39):
+    ``cpki init`` creates the cluster CA; ``cpki issue NAME`` signs an
+    endpoint cert with SANs for its hostnames/IPs."""
+    from cranesched_tpu.utils import pki
+    if args.action == "init":
+        ca, key = pki.create_ca(args.dir)
+        print(f"cluster CA created: {ca}\nCA key (keep private): {key}")
+        print("distribute ca.pem to clients (~/.crane/ca.pem) and "
+              "craneds (--tls-ca)")
+        return 0
+    if not args.name:
+        print("cpki issue requires a NAME", file=sys.stderr)
+        return 2
+    ca = os.path.join(args.dir, "ca.pem")
+    ca_key = os.path.join(args.dir, "ca.key")
+    if not (os.path.exists(ca_key) and os.path.exists(ca)):
+        print(f"no CA at {args.dir} (run cpki init first)",
+              file=sys.stderr)
+        return 2
+    dns = tuple(d for d in args.dns.split(",") if d)
+    ips = tuple(i for i in args.ip.split(",") if i)
+    cert, key = pki.issue_cert(args.dir, args.name, ca, ca_key,
+                               dns=dns, ips=ips)
+    print(f"issued: {cert}\nkey: {key}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     top = argparse.ArgumentParser(prog="crane")
     top.add_argument("--server",
@@ -577,6 +650,15 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--token", default="",
                      help="bearer token (default: $CRANE_TOKEN or "
                           "~/.crane/token)")
+    top.add_argument("--ca", default="",
+                     help="cluster CA cert for TLS (default: $CRANE_CA "
+                          "or ~/.crane/ca.pem if present)")
+    top.add_argument("--cert", default="",
+                     help="client cert for mTLS clusters (default: "
+                          "$CRANE_CERT or ~/.crane/cert.pem)")
+    top.add_argument("--key", default="",
+                     help="client key for mTLS clusters (default: "
+                          "$CRANE_KEY or ~/.crane/key.pem)")
     sub = top.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("cbatch", help="submit a batch job")
@@ -635,6 +717,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="address craneds use to reach this client's "
                         "I/O stream (set to a routable IP/hostname on "
                         "multi-host clusters)")
+    p.add_argument("--io-cert", default="",
+                   help="serve the I/O stream over TLS with this cert "
+                        "(issue one with cpki issue <user>; on "
+                        "multi-host clusters issue it with "
+                        "--ip <bind-host> so supervisors can verify "
+                        "the advertised address)")
+    p.add_argument("--io-key", default="",
+                   help="key for --io-cert")
     p.set_defaults(func=cmd_crun)
 
     p = sub.add_parser("calloc",
@@ -720,6 +810,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--set", action="append", metavar="KEY=VALUE",
                    help="payload fields (JSON values accepted)")
     p.set_defaults(func=cmd_cacctmgr)
+
+    p = sub.add_parser("cpki",
+                       help="cluster PKI: init the CA / issue certs")
+    p.add_argument("action", choices=["init", "issue"])
+    p.add_argument("name", nargs="?", default="",
+                   help="endpoint name for issue (e.g. ctld, cn01)")
+    p.add_argument("--dir", default=os.path.expanduser("~/.crane/pki"),
+                   help="PKI directory (CA + issued certs)")
+    p.add_argument("--dns", default="",
+                   help="extra DNS SANs, comma-separated")
+    p.add_argument("--ip", default="",
+                   help="extra IP SANs, comma-separated")
+    p.set_defaults(func=cmd_cpki)
 
     p = sub.add_parser("cresv", help="manage reservations")
     p.add_argument("action", choices=["create", "delete"])

@@ -95,7 +95,8 @@ class CranedDaemon:
                  health_interval: float = 30.0,
                  gres: dict | None = None,
                  token: str = "",
-                 prolog: str = "", epilog: str = ""):
+                 prolog: str = "", epilog: str = "",
+                 tls=None):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -133,9 +134,31 @@ class CranedDaemon:
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = CgroupV2(cgroup_root)
+        # utils.pki.TlsConfig: dial the ctld over TLS (presenting this
+        # node's cert when the internal surface requires mTLS), serve
+        # the push surface over TLS, and hand supervisors the CA for
+        # their cfored dial-back
+        self.tls = tls
         # cluster-secret token for the ctld's craned-internal surface
-        # (auth-enabled clusters refuse unauthenticated registration)
-        self._ctld = CtldClient(ctld_address, timeout=10.0, token=token)
+        # (auth-enabled clusters refuse unauthenticated registration).
+        # The dial pins the control-plane identity ("ctld" — the name
+        # its cert is issued under) so no other cluster cert can
+        # impersonate it
+        if tls is not None and not (tls.cert and tls.key):
+            # half-configured TLS (CA only) would register fine over
+            # TLS but serve a PLAINTEXT push surface that a TLS ctld
+            # dispatcher can never reach — every dispatched job would
+            # fail.  Refuse at startup instead
+            raise ValueError(
+                "craned TLS needs a node cert+key (cpki issue "
+                f"{name}), not just the CA")
+        ctld_tls = None
+        if tls is not None:
+            import dataclasses as _dc
+            ctld_tls = _dc.replace(tls.for_client(),
+                                   override_authority="ctld")
+        self._ctld = CtldClient(ctld_address, timeout=10.0, token=token,
+                                tls=ctld_tls)
         # allocations (job-level: cgroup + GRES) and the steps running
         # inside them, keyed (job_id, step_id)
         self._allocs: dict[int, _Alloc] = {}
@@ -494,6 +517,13 @@ class CranedDaemon:
         cfored = ((step_spec.interactive_address
                    if step_spec and step_spec.interactive_address
                    else spec.interactive_address) or "")
+        # "tls://host:port" convention: the hub serves TLS, so the
+        # supervisor must dial back with the cluster CA (which rides
+        # this craned's --tls-ca; a TLS hub against a CA-less craned
+        # fails the handshake — loudly, not silently downgraded)
+        cfored_tls = cfored.startswith("tls://")
+        if cfored_tls:
+            cfored = cfored[len("tls://"):]
         cfored_token = ((step_spec.interactive_token
                          if step_spec and step_spec.interactive_token
                          else spec.interactive_token) or "")
@@ -521,7 +551,9 @@ class CranedDaemon:
             cfored=cfored, cfored_token=cfored_token, pty=use_pty,
             prolog=self.prolog, epilog=self.epilog,
             cgroup_procs=alloc.procs_path,
-            control_path=control_path, report_path=report_path)
+            control_path=control_path, report_path=report_path,
+            tls_ca=(self.tls.ca
+                    if cfored_tls and self.tls is not None else ""))
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()
@@ -856,7 +888,12 @@ class CranedDaemon:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(CRANED_SERVICE,
                                                   handlers),))
-        port = self._server.add_insecure_port(address)
+        if self.tls is not None and self.tls.cert:
+            from cranesched_tpu.utils.pki import server_credentials
+            port = self._server.add_secure_port(
+                address, server_credentials(self.tls))
+        else:
+            port = self._server.add_insecure_port(address)
         self._server.start()
         self.address = f"127.0.0.1:{port}"
         # recovery BEFORE the registration FSM: re-adopted steps must be

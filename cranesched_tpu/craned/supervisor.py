@@ -57,12 +57,15 @@ class _InteractiveIO:
     every output byte before the exit status (CforedClient.h:60-63)."""
 
     def __init__(self, address: str, job_id: int, step_id: int,
-                 use_pty: bool, token: str = ""):
+                 use_pty: bool, token: str = "", tls_ca: str = ""):
         self.address = address
         self.job_id = job_id
         self.step_id = step_id
         self.use_pty = use_pty
         self.token = token
+        # cluster CA path: when set, the dial-back to the cfored hub is
+        # TLS-verified (the stream token never travels plaintext)
+        self.tls_ca = tls_ca
         self._q: queue.Queue = queue.Queue()
         self._readers: list[threading.Thread] = []
         self._call = None
@@ -118,7 +121,13 @@ class _InteractiveIO:
         from cranesched_tpu.rpc import crane_pb2 as pb
         from cranesched_tpu.rpc.consts import CFORED_SERVICE
 
-        channel = grpc.insecure_channel(self.address)
+        if self.tls_ca:
+            from cranesched_tpu.utils.pki import (TlsConfig,
+                                                  secure_channel)
+            channel = secure_channel(self.address,
+                                     TlsConfig(ca=self.tls_ca))
+        else:
+            channel = grpc.insecure_channel(self.address)
 
         def requests():
             # the header presents the per-submission stream secret —
@@ -213,7 +222,8 @@ def main() -> int:
         interactive = _InteractiveIO(init["cfored"], job_id,
                                      int(init.get("step_id") or 0),
                                      bool(init.get("pty")),
-                                     token=init.get("cfored_token") or "")
+                                     token=init.get("cfored_token") or "",
+                                     tls_ca=init.get("tls_ca") or "")
 
     print("READY", flush=True)
     go = sys.stdin.readline().strip()

@@ -40,7 +40,19 @@ def main(argv=None) -> int:
     ap.add_argument("--epilog", default="",
                     help="task epilog script run after every step; "
                          "failure drains this node")
+    ap.add_argument("--tls-ca", default="",
+                    help="cluster CA cert: dial the ctld over TLS "
+                         "(requires --tls-cert/--tls-key)")
+    ap.add_argument("--tls-cert", default="",
+                    help="this node's cert (serves the push surface "
+                         "over TLS; presented to mTLS ctlds)")
+    ap.add_argument("--tls-key", default="",
+                    help="this node's key")
     args = ap.parse_args(argv)
+    if args.tls_ca and not (args.tls_cert and args.tls_key):
+        ap.error("--tls-ca requires --tls-cert and --tls-key "
+                 "(a CA-only craned would serve a plaintext push "
+                 "surface no TLS ctld can dispatch to)")
 
     token = args.token
     if not token and args.token_file:
@@ -49,6 +61,7 @@ def main(argv=None) -> int:
 
     from cranesched_tpu.craned.daemon import CranedDaemon
     from cranesched_tpu.utils.config import parse_mem
+    from cranesched_tpu.utils.pki import TlsConfig
 
     gres = {}
     if args.gres:
@@ -64,7 +77,10 @@ def main(argv=None) -> int:
         health_program=args.health_program,
         health_interval=args.health_interval,
         gres=gres, token=token,
-        prolog=args.prolog, epilog=args.epilog)
+        prolog=args.prolog, epilog=args.epilog,
+        tls=(TlsConfig(ca=args.tls_ca, cert=args.tls_cert,
+                       key=args.tls_key)
+             if args.tls_ca else None))
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

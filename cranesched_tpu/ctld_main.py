@@ -82,13 +82,15 @@ def main(argv=None) -> int:
 
     sim = None
     dispatcher = None
+    tls = cfg.tls_config()
     if args.sim:
         for node in meta.nodes.values():
             node.alive = True
         sim = SimCluster(scheduler)
         sim.wire(scheduler)
     else:
-        dispatcher = GrpcDispatcher(scheduler)
+        dispatcher = GrpcDispatcher(
+            scheduler, tls=tls.for_client() if tls else None)
         dispatcher.wire(scheduler)
 
     if cfg.node_event_hook_path:
@@ -111,10 +113,11 @@ def main(argv=None) -> int:
     address = args.listen or cfg.listen
     server, port = serve(scheduler, sim=sim, address=address,
                          cycle_interval=args.cycle_interval,
-                         dispatcher=dispatcher, auth=auth)
+                         dispatcher=dispatcher, auth=auth, tls=tls)
     print(f"cranectld [{cfg.cluster_name}] listening on port {port} "
           f"({'simulated' if args.sim else 'real'} node plane, "
-          f"{len(meta.nodes)} nodes configured)", flush=True)
+          f"{len(meta.nodes)} nodes configured"
+          f"{', TLS' if tls else ''})", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

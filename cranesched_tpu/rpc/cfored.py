@@ -113,10 +113,14 @@ class CforedServer:
     forge the exit status).  Empty = open hub (tests, trusted loopback).
     """
 
-    def __init__(self, secret: str | None = None):
+    def __init__(self, secret: str | None = None, tls=None):
         import secrets as _secrets
         self.secret = (_secrets.token_urlsafe(16) if secret is None
                        else secret)
+        # utils.pki.TlsConfig: the hub serves TLS and supervisors dial
+        # back with the cluster CA (their side rides the craned's
+        # config) — the stream secret stops being sniffable in flight
+        self.tls = tls
         self._sessions: dict[tuple[int, int], StepIOSession] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
@@ -182,9 +186,17 @@ class CforedServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(
                 CFORED_SERVICE, {"StepIO": handler}),))
-        port = self._server.add_insecure_port(address)
+        if self.tls is not None:
+            from cranesched_tpu.utils.pki import server_credentials
+            port = self._server.add_secure_port(
+                address, server_credentials(self.tls))
+        else:
+            port = self._server.add_insecure_port(address)
         self._server.start()
-        self.address = f"{host_for_clients}:{port}"
+        # tls:// marks the advertised address so craneds know the
+        # supervisor must dial back with the cluster CA
+        scheme = "tls://" if self.tls is not None else ""
+        self.address = f"{scheme}{host_for_clients}:{port}"
         return self.address
 
     def stop(self) -> None:

@@ -10,10 +10,11 @@ from cranesched_tpu.rpc.stub import GrpcStub
 
 class CtldClient:
     def __init__(self, address: str, timeout: float = 30.0,
-                 token: str = ""):
+                 token: str = "", tls=None):
         self.address = address
         self.timeout = timeout
-        self._stub = GrpcStub(address, SERVICE, timeout, token=token)
+        self._stub = GrpcStub(address, SERVICE, timeout, token=token,
+                              tls=tls)
         # kept for tests that introspect the channel
         self._channel = self._stub._channel
 

@@ -34,16 +34,20 @@ from cranesched_tpu.rpc.stub import GrpcStub
 class _CranedStub(GrpcStub):
     """One channel per craned (reference CranedStub)."""
 
-    def __init__(self, address: str, timeout: float = 10.0):
-        super().__init__(address, CRANED_SERVICE, timeout)
+    def __init__(self, address: str, timeout: float = 10.0, tls=None):
+        super().__init__(address, CRANED_SERVICE, timeout, tls=tls)
 
     def call(self, name, request, reply_cls=pb.OkReply):
         return super().call(name, request, reply_cls)
 
 
 class GrpcDispatcher:
-    def __init__(self, scheduler, max_workers: int = 8):
+    def __init__(self, scheduler, max_workers: int = 8, tls=None):
         self.scheduler = scheduler
+        # utils.pki.TlsConfig: push channels to craneds dial TLS,
+        # verified against the cluster CA (craneds serve their node
+        # certs) — the internal fabric's encrypted half
+        self.tls = tls
         self._stubs: dict[int, _CranedStub] = {}
         self._lock = threading.Lock()
         self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
@@ -61,13 +65,22 @@ class GrpcDispatcher:
         scheduler.dispatch_change_time_limit = self.change_time_limit
 
     def node_registered(self, node_id: int, address: str) -> None:
+        tls = self.tls
+        if tls is not None:
+            # pin the channel to the node's own cert identity: a
+            # compromised node redirecting its address at another
+            # node's port cannot answer as it (certs are per-name)
+            node = self.scheduler.meta.nodes.get(node_id)
+            if node is not None:
+                import dataclasses as _dc
+                tls = _dc.replace(tls, override_authority=node.name)
         with self._lock:
             old = self._stubs.get(node_id)
             if old is not None and old.address != address:
                 old.close()
                 old = None
             if old is None:
-                self._stubs[node_id] = _CranedStub(address)
+                self._stubs[node_id] = _CranedStub(address, tls=tls)
 
     def _stub(self, node_id: int) -> _CranedStub | None:
         with self._lock:

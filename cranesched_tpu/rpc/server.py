@@ -59,7 +59,7 @@ class CtldServer:
     def __init__(self, scheduler: JobScheduler,
                  sim: SimCluster | None = None,
                  cycle_interval: float = 1.0, tick_mode: bool = False,
-                 dispatcher=None, auth=None):
+                 dispatcher=None, auth=None, tls=None):
         self.scheduler = scheduler
         self.sim = sim
         # real node plane: per-node push stubs (wired into the
@@ -69,6 +69,11 @@ class CtldServer:
         # reference's equivalent seam is CheckCertAndUIDAllowed_ on
         # every external RPC, CtldGrpcServer.h:568)
         self.auth = auth
+        # utils.pki.TlsConfig or None = plaintext (sims/tests); with
+        # require_client_cert set, callers must present a cluster-CA
+        # cert — the reference's internal mTLS domain
+        # (CtldPublicDefs.h:133-143)
+        self.tls = tls
         self.cycle_interval = cycle_interval
         self.tick_mode = tick_mode
         self._lock = threading.Lock()
@@ -739,7 +744,12 @@ class CtldServer:
             futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
-        port = self._server.add_insecure_port(address)
+        if self.tls is not None:
+            from cranesched_tpu.utils.pki import server_credentials
+            port = self._server.add_secure_port(
+                address, server_credentials(self.tls))
+        else:
+            port = self._server.add_insecure_port(address)
         self._server.start()
         if not self.tick_mode:
             self._cycle_thread = threading.Thread(

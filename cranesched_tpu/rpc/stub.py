@@ -1,6 +1,8 @@
-"""Generic unary-unary gRPC stub over an insecure channel with a lazy
-per-method cache — the single transport plumbing shared by the CLI/ctld
-client and the ctld->craned dispatcher."""
+"""Generic unary-unary gRPC stub with a lazy per-method cache — the
+single transport plumbing shared by the CLI/ctld client and the
+ctld->craned dispatcher.  Plaintext by default; pass a
+``utils.pki.TlsConfig`` to dial TLS (with a client cert when the peer
+requires mTLS)."""
 
 from __future__ import annotations
 
@@ -9,14 +11,18 @@ import grpc
 
 class GrpcStub:
     def __init__(self, address: str, service: str, timeout: float = 30.0,
-                 token: str = ""):
+                 token: str = "", tls=None):
         self.address = address
         self.service = service
         self.timeout = timeout
         # bearer token attached as metadata on every call (verified by
         # the ctld's AuthManager; empty = unauthenticated)
         self.token = token
-        self._channel = grpc.insecure_channel(address)
+        if tls is not None:
+            from cranesched_tpu.utils.pki import secure_channel
+            self._channel = secure_channel(address, tls)
+        else:
+            self._channel = grpc.insecure_channel(address)
         self._stubs = {}
 
     def call(self, name, request, reply_cls):

@@ -94,6 +94,22 @@ class CraneConfig:
     # Plugin.proto:75-95): run with CRANE_EVENT/CRANE_NODE/... env on
     # up/down/drain/undrain/power transitions
     node_event_hook_path: str = ""
+    # transport security (reference TLS domains CtldPublicDefs.h:
+    # 133-143): Tls: {Ca, Cert, Key, RequireClientCert} — empty Ca =
+    # plaintext wire (sims, trusted loopback)
+    tls: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def tls_config(self):
+        """-> utils.pki.TlsConfig for the ctld server, or None."""
+        if not self.tls.get("Ca"):
+            return None
+        from cranesched_tpu.utils.pki import TlsConfig
+        return TlsConfig(
+            ca=str(self.tls["Ca"]),
+            cert=str(self.tls.get("Cert", "") or ""),
+            key=str(self.tls.get("Key", "") or ""),
+            require_client_cert=bool(
+                self.tls.get("RequireClientCert", False)))
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -275,4 +291,5 @@ def load_config(path: str) -> CraneConfig:
             (raw.get("Auth") or {}).get("TokenFile", "") or ""),
         auth_admins=[str(a) for a in
                      (raw.get("Auth") or {}).get("Admins", ["root"])],
-        node_event_hook_path=str(raw.get("NodeEventHook", "") or ""))
+        node_event_hook_path=str(raw.get("NodeEventHook", "") or ""),
+        tls=raw.get("Tls", {}) or {})
