@@ -106,6 +106,9 @@ def _tls(args):
     cert = (getattr(args, "cert", "")
             or os.environ.get("CRANE_CERT", ""))
     key = getattr(args, "key", "") or os.environ.get("CRANE_KEY", "")
+    if bool(cert) != bool(key):
+        raise SystemExit("crane: --cert/$CRANE_CERT and "
+                         "--key/$CRANE_KEY go together")
     if not cert:
         dcert = os.path.expanduser("~/.crane/cert.pem")
         dkey = os.path.expanduser("~/.crane/key.pem")

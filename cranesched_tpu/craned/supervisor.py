@@ -271,11 +271,13 @@ def main() -> int:
             ["bash", "-c", script], stdout=out, stderr=out, env=env,
             start_new_session=True)
     # optional cgroup attachment (the craned pre-created the cgroup and
-    # passed its cgroup.procs path)
+    # passed its cgroup.procs path — one for v2, one per controller
+    # hierarchy for v1)
     procs_path = init.get("cgroup_procs")
-    if procs_path:
+    for pp in ([procs_path] if isinstance(procs_path, str)
+               else procs_path or []):
         try:
-            with open(procs_path, "w") as fh:
+            with open(pp, "w") as fh:
                 fh.write(str(child.pid))
         except OSError:
             pass  # cgroupfs unavailable: resource limits best-effort

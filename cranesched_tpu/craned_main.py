@@ -8,6 +8,7 @@ Craned.cpp bootstrap).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -28,6 +29,11 @@ def main(argv=None) -> int:
     ap.add_argument("--health-interval", type=float, default=30.0)
     ap.add_argument("--gres", default="",
                     help="name[:type]:count, comma-separated")
+    ap.add_argument("--gres-devices", default="",
+                    help="device files backing GRES slots for the "
+                         "kernel cgroup ACL: name[:type]=/dev/a;/dev/b"
+                         " entries, comma-separated (reference "
+                         "config.yaml Gres device files)")
     ap.add_argument("--token", default="",
                     help="cluster secret for auth-enabled ctlds "
                          "(the @craned entry in the token table)")
@@ -48,6 +54,10 @@ def main(argv=None) -> int:
                          "over TLS; presented to mTLS ctlds)")
     ap.add_argument("--tls-key", default="",
                     help="this node's key")
+    ap.add_argument("--tls-name",
+                    default=os.environ.get("CRANE_TLS_NAME", "ctld"),
+                    help="name the ctld's cert is issued under "
+                         "(identity pin for the dial; default ctld)")
     args = ap.parse_args(argv)
     if args.tls_ca and not (args.tls_cert and args.tls_key):
         ap.error("--tls-ca requires --tls-cert and --tls-key "
@@ -67,6 +77,10 @@ def main(argv=None) -> int:
     if args.gres:
         from cranesched_tpu.cli import _parse_gres
         gres = _parse_gres(args.gres)  # daemon normalizes string keys
+    gres_devices = {}
+    for entry in filter(None, args.gres_devices.split(",")):
+        key, _, paths = entry.partition("=")
+        gres_devices[key.strip()] = [p for p in paths.split(";") if p]
 
     daemon = CranedDaemon(
         args.name, args.ctld, cpu=args.cpu,
@@ -76,11 +90,12 @@ def main(argv=None) -> int:
         cgroup_root=args.cgroup_root,
         health_program=args.health_program,
         health_interval=args.health_interval,
-        gres=gres, token=token,
+        gres=gres, gres_devices=gres_devices, token=token,
         prolog=args.prolog, epilog=args.epilog,
         tls=(TlsConfig(ca=args.tls_ca, cert=args.tls_cert,
                        key=args.tls_key)
-             if args.tls_ca else None))
+             if args.tls_ca else None),
+        tls_name=args.tls_name)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

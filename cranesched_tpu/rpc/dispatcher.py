@@ -72,8 +72,7 @@ class GrpcDispatcher:
             # node's port cannot answer as it (certs are per-name)
             node = self.scheduler.meta.nodes.get(node_id)
             if node is not None:
-                import dataclasses as _dc
-                tls = _dc.replace(tls, override_authority=node.name)
+                tls = tls.pinned(node.name)
         with self._lock:
             old = self._stubs.get(node_id)
             if old is not None and old.address != address:

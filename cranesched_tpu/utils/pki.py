@@ -63,6 +63,12 @@ class TlsConfig:
         """A client view of this endpoint config (same files)."""
         return dataclasses.replace(self, require_client_cert=False)
 
+    def pinned(self, authority: str) -> "TlsConfig":
+        """Client view pinned to a specific peer identity (the name the
+        peer's cert was issued under) — the impersonation guard."""
+        return dataclasses.replace(self, require_client_cert=False,
+                                   override_authority=authority)
+
 
 # ---------------------------------------------------------------------------
 # key material

@@ -187,7 +187,10 @@ def test_real_plane_all_tls(cluster_pki, tmp_path):
     meta = MetaContainer()
     sched = JobScheduler(meta, SchedulerConfig(
         backfill=False, craned_timeout=5.0))
-    dispatcher = GrpcDispatcher(sched, tls=pki.TlsConfig(ca=ca))
+    # the dispatcher presents the ctld's cert: craned push surfaces
+    # demand a cluster-CA client cert under TLS
+    dispatcher = GrpcDispatcher(sched, tls=pki.TlsConfig(
+        ca=ca, cert=ctld_cert, key=ctld_key))
     dispatcher.wire(sched)
     server, port = serve(
         sched, cycle_interval=0.15, dispatcher=dispatcher,
