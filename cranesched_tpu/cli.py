@@ -237,6 +237,9 @@ def _build_spec(args):
     if args.gres:
         for key, count in _parse_gres(args.gres).items():
             spec.res.gres[key] = count
+    if getattr(args, "image", ""):
+        spec.container_image = args.image
+        spec.container_mounts.extend(getattr(args, "mount", []) or [])
     return spec
 
 
@@ -376,7 +379,13 @@ def _run_step_in_alloc(args, client, cfored) -> int:
                        time_limit=args.time,
                        interactive_address=cfored.address,
                        interactive_token=cfored.secret,
-                       pty=args.pty)
+                       pty=args.pty,
+                       overlap=getattr(args, "overlap", False))
+    if getattr(args, "follow_step", None) is not None:
+        spec.follow_step = args.follow_step
+    if getattr(args, "image", ""):
+        spec.container_image = args.image
+        spec.container_mounts.extend(getattr(args, "mount", []) or [])
     if args.cpu or args.mem != "0":
         spec.res.CopyFrom(pb.ResourceSpec(
             cpu=args.cpu, mem_bytes=_parse_mem(args.mem)))
@@ -398,6 +407,51 @@ def _run_step_in_alloc(args, client, cfored) -> int:
     return _stream_session(
         sess, cancel=lambda: client.cancel_step(args.jobid, step_id),
         status_poll=status_poll)
+
+
+def cmd_ccon(args) -> int:
+    """Container jobs (reference ccon, ContainerInstance): ``ccon run
+    IMAGE SCRIPT`` submits a batch job whose step runs inside IMAGE on
+    the node's OCI runtime, with the job's GRES/env crossing the
+    boundary."""
+    args.image = args.image_name
+    spec = _build_spec(args)
+    client = _client(args)
+    reply = client.submit(spec)
+    if reply.job_id:
+        print(f"Submitted container job {reply.job_id} "
+              f"({args.image_name})")
+        return 0
+    print(f"ccon: submit failed: {reply.error}", file=sys.stderr)
+    return 1
+
+
+def cmd_cattach(args) -> int:
+    """Attach interactively to a RUNNING container step (reference
+    cattach): runs ``$CRANE_CONTAINER_RUNTIME attach <name>`` as a new
+    step inside the job's allocation, streaming through the embedded
+    CraneFored hub — stdin/stdout reach the primary container."""
+    from cranesched_tpu.rpc.cfored import CforedServer
+    client = _client(args)
+    cfored = CforedServer()
+    cfored.start(host_for_clients=args.bind_host)
+    try:
+        args.jobid = args.job_id
+        args.script = (f'exec "$CRANE_CONTAINER_RUNTIME" attach '
+                       f'crane-j{args.job_id}-s{args.step}')
+        args.job_name = f"cattach-s{args.step}"
+        args.nodes = 1
+        args.time = 0
+        args.cpu = 0.0
+        args.mem = "0"
+        args.pty = True
+        args.overlap = True   # observation channel: holds no share
+        args.image = ""       # the attach runs on the HOST runtime
+        args.follow_step = args.step  # land on the container's node
+        return _run_step_in_alloc(args, client, cfored)
+    finally:
+        cfored.stop()
+        client.close()
 
 
 def cmd_crun(args) -> int:
@@ -696,6 +750,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch script (bash -c) for real node planes")
     p.add_argument("--output", "-o", default="",
                    help="output file pattern (%%j = job id)")
+    p.add_argument("--image", default="",
+                   help="run the batch step inside this OCI image")
+    p.add_argument("--mount", action="append", default=[],
+                   help="host:ctr[:ro] bind for --image (repeatable)")
     p.set_defaults(func=cmd_cbatch)
 
     p = sub.add_parser("crun", help="run a command and stream output")
@@ -728,7 +786,48 @@ def build_parser() -> argparse.ArgumentParser:
                         "the advertised address)")
     p.add_argument("--io-key", default="",
                    help="key for --io-cert")
+    p.add_argument("--image", default="",
+                   help="run the command inside this OCI image "
+                        "(node's podman/docker)")
+    p.add_argument("--mount", action="append", default=[],
+                   help="host:ctr[:ro] bind for --image (repeatable)")
+    p.add_argument("--overlap", action="store_true",
+                   help="hold no share of the allocation "
+                        "(observation steps)")
     p.set_defaults(func=cmd_crun)
+
+    p = sub.add_parser("ccon", help="container jobs (ccon run IMAGE "
+                                    "SCRIPT)")
+    ccon_sub = p.add_subparsers(dest="ccon_action", required=True)
+    pr = ccon_sub.add_parser("run", help="submit a container batch job")
+    pr.add_argument("image_name", metavar="IMAGE")
+    pr.add_argument("script", help="command run inside the container "
+                                   "(bash -c)")
+    pr.add_argument("--job-name", "-J", default="ccon")
+    pr.add_argument("--user", default=os.environ.get("USER", "user"))
+    pr.add_argument("--account", "-A", default="default")
+    pr.add_argument("--partition", "-p", default="default")
+    pr.add_argument("--cpu", "-c", type=float, default=1.0)
+    pr.add_argument("--mem", default="0")
+    pr.add_argument("--memsw", default="")
+    pr.add_argument("--nodes", "-N", type=int, default=1)
+    pr.add_argument("--gres", default="")
+    pr.add_argument("--time", "-t", type=int, default=3600)
+    pr.add_argument("--qos", "-q", default="")
+    pr.add_argument("--reservation", default="")
+    pr.add_argument("--mount", action="append", default=[],
+                    help="host:ctr[:ro] bind mount (repeatable)")
+    pr.add_argument("--output", "-o", default="",
+                    help="output file pattern (%%j = job id)")
+    pr.set_defaults(func=cmd_ccon)
+
+    p = sub.add_parser("cattach",
+                       help="attach to a running container step")
+    p.add_argument("job_id", type=int)
+    p.add_argument("--step", type=int, default=0,
+                   help="step whose container to attach (default 0)")
+    p.add_argument("--bind-host", default="127.0.0.1")
+    p.set_defaults(func=cmd_cattach)
 
     p = sub.add_parser("calloc",
                        help="allocate resources (steps run via "

@@ -101,7 +101,8 @@ class CranedDaemon:
                  gres_devices: dict | None = None,
                  token: str = "",
                  prolog: str = "", epilog: str = "",
-                 tls=None, tls_name: str = "ctld"):
+                 tls=None, tls_name: str = "ctld",
+                 container_runtime: str | None = None):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -149,6 +150,14 @@ class CranedDaemon:
                 else tuple(key)
             for slot, path in zip(self._gres_free.get(pair, ()), paths):
                 self._gres_slot_dev[(pair, slot)] = path
+        # OCI runtime CLI for container steps (reference CriClient /
+        # ContainerInstance; podman and docker share the verb surface).
+        # None = auto-detect; "" = containers unsupported on this node
+        if container_runtime is None:
+            import shutil as _shutil
+            container_runtime = (_shutil.which("podman")
+                                 or _shutil.which("docker") or "")
+        self.container_runtime = container_runtime
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = make_cgroups(cgroup_root)
@@ -535,6 +544,26 @@ class CranedDaemon:
         step_env["CRANE_STEP_ID"] = str(step_id)
         if step_spec and step_spec.name:
             step_env["CRANE_STEP_NAME"] = step_spec.name
+        # container step fields (reference ContainerInstance,
+        # TaskManager.h:353): a step-level image wins over the job's
+        image = (step_spec.container_image
+                 if step_spec and step_spec.container_image
+                 else spec.container_image)
+        mounts = list(step_spec.container_mounts
+                      if step_spec and step_spec.container_mounts
+                      else spec.container_mounts)
+        if image and not self.container_runtime:
+            # fail loudly at spawn, not with a cryptic exec error: the
+            # dispatcher reports this step Failed to ctld
+            raise RuntimeError(
+                "container step needs an OCI runtime (podman/docker) "
+                "on this node — none configured or found")
+        if self.container_runtime:
+            # cattach and in-step tooling find the runtime + the
+            # primary container's deterministic name
+            step_env["CRANE_CONTAINER_RUNTIME"] = self.container_runtime
+            step_env["CRANE_CONTAINER_NAME"] = \
+                f"crane-j{job_id}-s{step_id}"
         # gang rendezvous env (the PMIx fork-env role, Pmix.h:54-57):
         # every member can enumerate the gang and find the coordinator.
         # Per-REQUEST values (rank differs per node; a step's span can
@@ -600,7 +629,11 @@ class CranedDaemon:
             cgroup_procs=alloc.procs_path,
             control_path=control_path, report_path=report_path,
             tls_ca=(self.tls.ca
-                    if cfored_tls and self.tls is not None else ""))
+                    if cfored_tls and self.tls is not None else ""),
+            container=self._container_doc(
+                job_id, step_id, image, mounts, alloc,
+                step_spec.res if step_spec and step_spec.HasField("res")
+                else spec.res) if image else None)
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()
@@ -729,6 +762,27 @@ class CranedDaemon:
         with self._lock:
             self._cores_free.extend(cores)
             self._cores_free.sort()
+
+    def _container_doc(self, job_id: int, step_id: int, image: str,
+                       mounts: list, alloc, res) -> dict:
+        """Init-JSON container block.  The supervisor's cgroup holds
+        only the runtime CLI (the workload lives under the runtime
+        daemon), so the job's limits are RESTATED as runtime flags and
+        its held GRES device nodes cross via --device — otherwise a
+        container job gets env vars pointing at devices that don't
+        exist inside, and no kernel limit at all."""
+        devices = [path for pair, slots in alloc.gres_held.items()
+                   for slot in slots
+                   if (path := self._gres_slot_dev.get((pair, slot)))
+                   is not None]
+        return dict(
+            runtime=self.container_runtime, image=image, mounts=mounts,
+            name=f"crane-j{job_id}-s{step_id}",
+            cpu=res.cpu or 0, mem_bytes=res.mem_bytes or 0,
+            cpuset=alloc.env.get("CRANE_CPUSET", ""),
+            devices=devices,
+            cgroup_parent=(f"crane/job_{job_id}"
+                           if self.cgroups.enabled else ""))
 
     def _device_rule(self, pair, slot: int) -> str | None:
         """'c MAJ:MIN rwm' for a held GRES slot's device node, from the

@@ -72,13 +72,13 @@ class _InteractiveIO:
         self._child = None
         self._pty_master = None
 
-    def spawn(self, script: str, env: dict) -> subprocess.Popen:
+    def spawn(self, argv: list, env: dict) -> subprocess.Popen:
         if self.use_pty:
             import pty
             master, slave = pty.openpty()
             self._pty_master = master
             child = subprocess.Popen(
-                ["bash", "-c", script], stdin=slave, stdout=slave,
+                argv, stdin=slave, stdout=slave,
                 stderr=slave, env=env, start_new_session=True)
             os.close(slave)
             t = threading.Thread(target=self._read_fd,
@@ -87,7 +87,7 @@ class _InteractiveIO:
             self._readers = [t]
         else:
             child = subprocess.Popen(
-                ["bash", "-c", script], stdin=subprocess.PIPE,
+                argv, stdin=subprocess.PIPE,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 env=env, start_new_session=True)
             self._readers = [
@@ -209,6 +209,70 @@ class _InteractiveIO:
                 time.sleep(0.05)
 
 
+def _child_argv(script: str, env: dict, container: dict | None,
+                interactive: bool = False, pty: bool = False) -> list:
+    """argv of the step's child: plain ``bash -c`` for process steps,
+    or the OCI runtime command for container steps (the reference's
+    ProcInstance vs ContainerInstance split, TaskManager.h:293-466).
+
+    podman and docker share this verb surface.  The job's CRANE_* env
+    and accelerator-visibility vars cross the boundary explicitly
+    (--env); everything else in the supervisor env stays on the host
+    side.
+
+    Isolation composition: the supervisor's cgroup holds only the
+    runtime CLI — the container workload lives under the runtime
+    daemon's cgroup — so the job's limits are restated as runtime
+    flags (--cpus/--memory/--cpuset-cpus, --cgroup-parent where the
+    driver honors it) and the job's held GRES device nodes cross via
+    --device (env vars alone would point at nodes absent from the
+    container)."""
+    if not container or not container.get("image"):
+        return ["bash", "-c", script]
+    argv = [container["runtime"], "run", "--rm",
+            "--name", container["name"]]
+    if interactive:
+        argv.append("-i")
+        if pty:
+            argv.append("-t")
+    if container.get("cpu"):
+        argv.append(f"--cpus={container['cpu']}")
+    if container.get("mem_bytes"):
+        argv.append(f"--memory={int(container['mem_bytes'])}b")
+    if container.get("cpuset"):
+        argv.append(f"--cpuset-cpus={container['cpuset']}")
+    if container.get("cgroup_parent"):
+        argv.append(f"--cgroup-parent={container['cgroup_parent']}")
+    for dev in container.get("devices") or ():
+        argv += ["--device", dev]
+    for mount in container.get("mounts") or ():
+        argv += ["-v", mount]
+    for key in sorted(env):
+        if key.startswith("CRANE_") or key.endswith("_VISIBLE_DEVICES")\
+                or key.startswith("ASCEND_RT_"):
+            argv += ["--env", f"{key}={env[key]}"]
+    argv += [container["image"], "bash", "-c", script]
+    return argv
+
+
+def _container_rm(container: dict | None) -> None:
+    """Force-remove the step's named container (idempotent).  Called
+    before run (a stale same-name container from a previous
+    incarnation blocks the new one) and on every kill path: killing
+    the runtime CLI's process group does NOT kill the container —
+    dockerd owns it, and conmon setsids away — so the workload would
+    survive on resources the craned already freed."""
+    if not container or not container.get("image"):
+        return
+    try:
+        subprocess.run(
+            [container["runtime"], "rm", "-f", container["name"]],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        pass
+
+
 def main() -> int:
     init = json.loads(sys.stdin.readline())
     job_id = init["job_id"]
@@ -264,11 +328,16 @@ def main() -> int:
             report(f"PROLOGFAIL {rc}")
             return 0
 
+    container = init.get("container")
+    argv = _child_argv(script, env, container,
+                       interactive=interactive is not None,
+                       pty=bool(init.get("pty")))
+    _container_rm(container)  # stale same-name container blocks run
     if interactive is not None:
-        child = interactive.spawn(script, env)
+        child = interactive.spawn(argv, env)
     else:
         child = subprocess.Popen(
-            ["bash", "-c", script], stdout=out, stderr=out, env=env,
+            argv, stdout=out, stderr=out, env=env,
             start_new_session=True)
     # optional cgroup attachment (the craned pre-created the cgroup and
     # passed its cgroup.procs path — one for v2, one per controller
@@ -291,9 +360,15 @@ def main() -> int:
             if verb == "TERM":
                 state["terminated"] = True
                 os.killpg(child.pid, signal.SIGTERM)
-                escalate = threading.Timer(
-                    5.0, lambda: child.poll() is None
-                    and os.killpg(child.pid, signal.SIGKILL))
+
+                def _escalate():
+                    if child.poll() is None:
+                        os.killpg(child.pid, signal.SIGKILL)
+                    # the container outlives its CLI (dockerd/conmon
+                    # own it): remove it or the workload survives on
+                    # freed resources and the name blocks re-dispatch
+                    _container_rm(container)
+                escalate = threading.Timer(5.0, _escalate)
                 escalate.daemon = True  # never delays supervisor exit
                 escalate.start()
             elif verb == "STOP":
@@ -357,6 +432,7 @@ def main() -> int:
             except ProcessLookupError:
                 pass
             child.wait()
+            _container_rm(container)
             if interactive is not None:
                 interactive.finish(124)
             suffix = ""

@@ -54,6 +54,10 @@ def main(argv=None) -> int:
                          "over TLS; presented to mTLS ctlds)")
     ap.add_argument("--tls-key", default="",
                     help="this node's key")
+    ap.add_argument("--container-runtime", default=None,
+                    help="OCI runtime CLI for container steps "
+                         "(default: auto-detect podman/docker; "
+                         "'' disables)")
     ap.add_argument("--tls-name",
                     default=os.environ.get("CRANE_TLS_NAME", "ctld"),
                     help="name the ctld's cert is issued under "
@@ -95,7 +99,8 @@ def main(argv=None) -> int:
         tls=(TlsConfig(ca=args.tls_ca, cert=args.tls_cert,
                        key=args.tls_key)
              if args.tls_ca else None),
-        tls_name=args.tls_name)
+        tls_name=args.tls_name,
+        container_runtime=args.container_runtime)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

@@ -158,6 +158,16 @@ class StepSpec:
     interactive_address: str = ""
     pty: bool = False
     interactive_token: str = ""
+    # container step: run the script inside this OCI image via the
+    # node's runtime (reference ContainerInstance, TaskManager.h:353)
+    container_image: str = ""
+    container_mounts: Sequence[str] = ()
+    # observation channel (cattach): starts immediately, holds no
+    # share of the allocation (Slurm --overlap analog)
+    overlap: bool = False
+    # overlap placement: run on the nodes of this RUNNING step (the
+    # cattach target); None = allocation prefix
+    follow_step: int | None = None
     # simulation-only (real planes learn these from the supervisor)
     sim_runtime: float | None = None
     sim_exit_code: int = 0
@@ -236,6 +246,11 @@ class JobSpec:
     interactive_address: str = ""
     pty: bool = False
     interactive_token: str = ""
+    # container job: the batch step runs inside this OCI image
+    # (reference ContainerInstance/PodInstance, TaskManager.h:293-353;
+    # ccon run).  Mounts are host:ctr[:ro] specs passed to the runtime.
+    container_image: str = ""
+    container_mounts: Sequence[str] = ()
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None

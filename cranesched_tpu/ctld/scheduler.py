@@ -993,7 +993,7 @@ class JobScheduler:
         free = [a.astype(np.int64).copy() for a in allocs]
         whole_busy = [False] * len(job.node_ids)
         for st in job.steps.values():
-            if st.status != StepStatus.RUNNING:
+            if st.status != StepStatus.RUNNING or st.spec.overlap:
                 continue
             req = self._step_req(job, st)
             for n in st.node_ids:
@@ -1005,6 +1005,30 @@ class JobScheduler:
         for step_id in sorted(job.steps):
             step = job.steps[step_id]
             if step.status != StepStatus.PENDING:
+                continue
+            if step.spec.overlap:
+                # observation channels (cattach): start immediately on
+                # the step's span without holding any share (the Slurm
+                # --overlap analog) — they neither block nor are
+                # blocked by the allocation's internal packing.  A
+                # follow_step targets the OBSERVED step's nodes (the
+                # container lives there, not on the prefix).
+                want = step.spec.node_num or len(job.node_ids)
+                nodes = None
+                if step.spec.follow_step is not None:
+                    tgt = job.steps.get(step.spec.follow_step)
+                    if tgt is not None and not tgt.status.is_terminal:
+                        if tgt.status != StepStatus.RUNNING:
+                            continue   # wait for the target to place
+                        nodes = list(tgt.node_ids)[:want] \
+                            if want < len(tgt.node_ids) \
+                            else list(tgt.node_ids)
+                step.status = StepStatus.RUNNING
+                step.start_time = now
+                step.node_ids = (nodes if nodes
+                                 else job.node_ids[:want])
+                started.append(step_id)
+                self.dispatch_step(job, step)
                 continue
             want = step.spec.node_num or len(job.node_ids)
             req = self._step_req(job, step)

@@ -83,6 +83,8 @@ def spec_from_pb(msg) -> JobSpec:
         interactive_address=msg.interactive_address,
         pty=msg.pty,
         interactive_token=msg.interactive_token,
+        container_image=msg.container_image,
+        container_mounts=tuple(msg.container_mounts),
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -111,6 +113,8 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         interactive_address=spec.interactive_address,
         pty=spec.pty,
         interactive_token=spec.interactive_token,
+        container_image=spec.container_image,
+        container_mounts=list(spec.container_mounts),
         sim_runtime=spec.sim_runtime or 0.0,
         sim_exit_code=spec.sim_exit_code)
     if spec.task_res is not None:
@@ -137,6 +141,11 @@ def step_spec_from_pb(msg) -> StepSpec:
         interactive_address=msg.interactive_address,
         pty=msg.pty,
         interactive_token=msg.interactive_token,
+        container_image=msg.container_image,
+        container_mounts=tuple(msg.container_mounts),
+        overlap=msg.overlap,
+        follow_step=(msg.follow_step
+                     if msg.HasField("follow_step") else None),
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -150,8 +159,13 @@ def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
                       interactive_address=spec.interactive_address,
                       pty=spec.pty,
                       interactive_token=spec.interactive_token,
+                      container_image=spec.container_image,
+                      container_mounts=list(spec.container_mounts),
+                      overlap=spec.overlap,
                       sim_runtime=spec.sim_runtime or 0.0,
                       sim_exit_code=spec.sim_exit_code)
+    if spec.follow_step is not None:
+        msg.follow_step = spec.follow_step
     if spec.res is not None:
         msg.res.CopyFrom(res_to_pb(spec.res))
     return msg

@@ -1,0 +1,316 @@
+"""Container steps: the ContainerInstance capability (reference
+src/Craned/Supervisor/TaskManager.h:293-353 — ProcInstance vs
+Container/Pod instances — and the ccon/cattach CLI surface).
+
+No OCI runtime exists in CI, so a FAKE runtime shim (bash) stands in:
+``run`` parses the podman/docker-shaped argv (--rm/--name/-v/--env/-i)
+and executes the container command in-process with ONLY the forwarded
+env; ``attach`` emits a recognizable banner and echoes stdin.  The
+shim asserts the argv contract; the plane tests assert the capability
+end to end (batch container job, interactive streaming through
+cfored, cattach as an overlap step)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.craned.supervisor import _child_argv
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+    StepSpec,
+    StepStatus,
+)
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.cfored import CforedServer
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+FAKE_RUNTIME = r"""#!/bin/bash
+# fake OCI runtime: podman/docker argv contract, local execution
+cmd=$1; shift
+case "$cmd" in
+  run)
+    declare -a envs; name=""; rm_seen=0; interactive=0; limits=""
+    while [[ $# -gt 0 ]]; do
+      case "$1" in
+        --rm) rm_seen=1; shift;;
+        -i|-t) interactive=1; shift;;
+        --name) name=$2; shift 2;;
+        -v) shift 2;;
+        --device) limits="$limits dev=$2"; shift 2;;
+        --cpus=*|--memory=*|--cpuset-cpus=*|--cgroup-parent=*)
+          limits="$limits ${1}"; shift;;
+        --env) envs+=("$2"); shift 2;;
+        *) break;;
+      esac
+    done
+    [[ $rm_seen == 1 ]] || { echo "BAD-ARGV: no --rm" >&2; exit 64; }
+    [[ -n $name ]] || { echo "BAD-ARGV: no --name" >&2; exit 64; }
+    image=$1; shift
+    echo "FAKE-RUN image=$image name=$name limits=[$limits ]"
+    exec env -i PATH="$PATH" "${envs[@]}" "$@"
+    ;;
+  attach)
+    echo "FAKE-ATTACH $1"
+    while IFS= read -r line; do echo "echoed: $line"; done
+    ;;
+  rm) exit 0;;   # idempotent force-remove
+  *) echo "unknown verb $cmd" >&2; exit 64;;
+esac
+"""
+
+
+@pytest.fixture()
+def fake_runtime(tmp_path):
+    path = tmp_path / "fakeoci"
+    path.write_text(FAKE_RUNTIME)
+    path.chmod(0o755)
+    return str(path)
+
+
+def test_child_argv_contract():
+    env = {"CRANE_JOB_ID": "7", "HOME": "/root",
+           "CUDA_VISIBLE_DEVICES": "0,1", "SECRET_HOST_VAR": "x"}
+    argv = _child_argv("echo hi", env, {
+        "runtime": "/usr/bin/podman", "image": "ubi9",
+        "mounts": ["/data:/data:ro"], "name": "crane-j7-s0",
+        "cpu": 2.0, "mem_bytes": 1 << 30, "cpuset": "0,1",
+        "devices": ["/dev/accel0"], "cgroup_parent": "crane/job_7"})
+    assert argv[:2] == ["/usr/bin/podman", "run"]
+    assert "--rm" in argv and "crane-j7-s0" in argv
+    assert "-v" in argv and "/data:/data:ro" in argv
+    joined = " ".join(argv)
+    # job identity and accelerator visibility cross the boundary;
+    # arbitrary host env does not
+    assert "CRANE_JOB_ID=7" in joined
+    assert "CUDA_VISIBLE_DEVICES=0,1" in joined
+    assert "SECRET_HOST_VAR" not in joined and "HOME=" not in joined
+    # limits are RESTATED as runtime flags (the workload lives under
+    # the runtime daemon's cgroup, not the supervisor's) and the held
+    # GRES device nodes cross via --device
+    assert "--cpus=2.0" in argv
+    assert f"--memory={1 << 30}b" in argv
+    assert "--cpuset-cpus=0,1" in argv
+    assert "--cgroup-parent=crane/job_7" in argv
+    assert "--device" in argv and "/dev/accel0" in argv
+    assert argv[-4:] == ["ubi9", "bash", "-c", "echo hi"]
+    # pty interactive gets -i -t; plain interactive only -i
+    it = _child_argv("x", {}, {"runtime": "p", "image": "i",
+                               "name": "n"},
+                     interactive=True, pty=True)
+    assert "-i" in it and "-t" in it
+    ni = _child_argv("x", {}, {"runtime": "p", "image": "i",
+                               "name": "n"}, interactive=True)
+    assert "-i" in ni and "-t" not in ni
+    # no image -> plain proc step
+    assert _child_argv("echo hi", env, None) == ["bash", "-c",
+                                                 "echo hi"]
+
+
+@pytest.fixture()
+def plane(tmp_path, fake_runtime):
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("cn0", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5,
+                     cgroup_root=str(tmp_path / "nocg"),
+                     container_runtime=fake_runtime)
+    d.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and d.state != CranedState.READY:
+        time.sleep(0.05)
+    assert d.state == CranedState.READY
+    yield sched, tmp_path
+    d.stop()
+    dispatcher.close()
+    server.stop()
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_batch_container_job(plane, tmp_path):
+    sched, _ = plane
+    out = tmp_path / "ctr_%j.txt"
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=1.0),
+        script="echo in-container-$CRANE_JOB_ID",
+        output_path=str(out),
+        container_image="ubi9:latest"), now=time.time())
+    assert _wait(lambda: (j := sched.job_info(jid)) is not None
+                 and j.status == JobStatus.COMPLETED), \
+        sched.job_info(jid).status
+    text = (tmp_path / f"ctr_{jid}.txt").read_text()
+    assert "FAKE-RUN image=ubi9:latest" in text
+    assert f"name=crane-j{jid}-s0" in text
+    assert f"in-container-{jid}" in text
+
+
+def test_interactive_container_streams_through_cfored(plane):
+    """crun --image: output of a containerized step streams to the
+    client hub (the e2e the round-3 verdict asked for)."""
+    sched, _ = plane
+    hub = CforedServer()
+    hub.start()
+    try:
+        jid = sched.submit(JobSpec(
+            res=ResourceSpec(cpu=1.0),
+            script="echo streamed-from-container",
+            container_image="alpine:3",
+            interactive_address=hub.address,
+            interactive_token=hub.secret), now=time.time())
+        sess = hub.expect(jid, 0)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for _, data in sess.read(timeout=20.0):
+                got.append(data)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        assert done.wait(timeout=20.0)
+        text = b"".join(got).decode()
+        assert "streamed-from-container" in text
+        assert "FAKE-RUN image=alpine:3" in text
+        assert sess.exit_code == 0
+    finally:
+        hub.stop()
+
+
+def test_cattach_overlap_step(plane):
+    """cattach semantics: an overlap step running the runtime's attach
+    verb starts WHILE the primary container step holds the whole
+    allocation, and its stdin/stdout round-trip through the hub."""
+    sched, _ = plane
+    hub = CforedServer()
+    hub.start()
+    try:
+        jid = sched.submit(JobSpec(
+            res=ResourceSpec(cpu=1.0),
+            script="sleep 30",
+            container_image="ubi9:latest",
+            time_limit=120), now=time.time())
+        assert _wait(lambda: jid in sched.running
+                     and sched.running[jid].status == JobStatus.RUNNING)
+        assert _wait(
+            lambda: sched.running[jid].steps
+            and sched.running[jid].steps[0].status == StepStatus.RUNNING)
+        step_id = sched.submit_step(jid, StepSpec(
+            name="cattach",
+            script='exec "$CRANE_CONTAINER_RUNTIME" attach '
+                   f"crane-j{jid}-s0",
+            overlap=True,
+            interactive_address=hub.address,
+            interactive_token=hub.secret), now=time.time())
+        assert step_id > 0
+        sess = hub.expect(jid, step_id)
+        got = []
+
+        def drain():
+            for _, data in sess.read(timeout=20.0):
+                got.append(data)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert _wait(lambda: any(b"FAKE-ATTACH" in g for g in got))
+        sess.send_stdin(b"hello-container\n")
+        assert _wait(lambda: any(b"echoed: hello-container" in g
+                                 for g in got))
+        sess.close_stdin()
+        sched.cancel(jid, now=time.time())
+        assert _wait(lambda: (j := sched.job_info(jid)) is not None
+                     and j.status.is_terminal)
+    finally:
+        hub.stop()
+
+
+def test_follow_step_places_overlap_on_target_node():
+    """cattach must land on the node where the observed step's
+    container runs, not the allocation prefix (review r4)."""
+    meta = MetaContainer()
+    for i in range(2):
+        meta.add_node(f"n{i}", meta.layout.encode(
+            cpu=2, mem_bytes=4 << 30, memsw_bytes=4 << 30,
+            is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=2.0), node_num=2, alloc_only=True),
+        now=0.0)
+    sched.schedule_cycle(now=1.0)
+    job = sched.running[jid]
+    s_a = sched.submit_step(jid, StepSpec(
+        name="a", script="x", res=ResourceSpec(cpu=2.0), node_num=1),
+        now=2.0)
+    s_b = sched.submit_step(jid, StepSpec(
+        name="b", script="x", res=ResourceSpec(cpu=2.0), node_num=1),
+        now=3.0)
+    assert job.steps[s_a].node_ids != job.steps[s_b].node_ids
+    target_nodes = job.steps[s_b].node_ids
+    s_at = sched.submit_step(jid, StepSpec(
+        name="cattach", script="attach", overlap=True,
+        follow_step=s_b, node_num=1), now=4.0)
+    att = job.steps[s_at]
+    assert att.status == StepStatus.RUNNING
+    assert att.node_ids == target_nodes
+
+    # following a still-pending step waits; prefix fallback only when
+    # no follow target is named
+    s_c = sched.submit_step(jid, StepSpec(
+        name="c", script="x", res=ResourceSpec(cpu=2.0), node_num=1),
+        now=5.0)
+    assert job.steps[s_c].status == StepStatus.PENDING
+    s_w = sched.submit_step(jid, StepSpec(
+        name="w", script="attach", overlap=True,
+        follow_step=s_c, node_num=1), now=6.0)
+    assert job.steps[s_w].status == StepStatus.PENDING
+
+
+def test_container_without_runtime_fails_cleanly(tmp_path):
+    """A node with no OCI runtime reports the container step Failed
+    with a legible error instead of a cryptic exec failure."""
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("cn1", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5,
+                     cgroup_root=str(tmp_path / "nocg"),
+                     container_runtime="")
+    d.start()
+    try:
+        assert _wait(lambda: d.state == CranedState.READY)
+        jid = sched.submit(JobSpec(
+            res=ResourceSpec(cpu=1.0), script="echo hi",
+            container_image="ubi9"), now=time.time())
+        assert _wait(lambda: (j := sched.job_info(jid)) is not None
+                     and j.status.is_terminal)
+        assert sched.job_info(jid).status == JobStatus.FAILED
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()
