@@ -512,16 +512,24 @@ def cmd_crun(args) -> int:
 
 
 def cmd_cqueue(args) -> int:
+    from cranesched_tpu.rpc.client import StreamResult
     client = _client(args)
-    reply = client.query_jobs(user=args.user, partition=args.partition,
-                              include_history=args.history)
     rows = []
-    for j in reply.jobs:
+    res = StreamResult()
+    # server-streaming: chunks arrive as they convert, so a 100k-job
+    # queue neither builds one giant message nor stalls the cycle
+    for j in client.query_jobs_stream(
+            user=args.user, partition=args.partition,
+            include_history=args.history, limit=args.limit,
+            after_job_id=args.after, result=res):
         rows.append((j.job_id, j.name[:20], j.user, j.partition,
                      j.status, j.pending_reason or "-",
                      ",".join(j.node_names) or "-"))
     print(_fmt_table(rows, ("JOBID", "NAME", "USER", "PARTITION",
                             "STATE", "REASON", "NODES")))
+    if res.truncated and rows:
+        print(f"# limited to {args.limit}; continue with "
+              f"--after {rows[-1][0]}")
     return 0
 
 
@@ -607,10 +615,20 @@ def cmd_ccontrol(args) -> int:
 
 
 def cmd_cacct(args) -> int:
+    from cranesched_tpu.rpc.client import StreamResult
     client = _client(args)
-    reply = client.query_jobs(user=args.user, include_history=True)
     rows = []
-    for j in reply.jobs:
+    res = StreamResult()
+    last_id = 0
+    for j in client.query_jobs_stream(user=args.user,
+                                      include_history=True,
+                                      limit=args.limit,
+                                      after_job_id=args.after,
+                                      result=res):
+        # the cursor advances over EVERY streamed id — the live-job
+        # filter below must not hide pages (a limit full of running
+        # jobs would otherwise read as "no history")
+        last_id = j.job_id
         if j.status in ("Pending", "Running", "Suspended"):
             continue
         wall = (j.end_time - j.start_time
@@ -619,6 +637,9 @@ def cmd_cacct(args) -> int:
                      j.exit_code, f"{wall:.0f}s"))
     print(_fmt_table(rows, ("JOBID", "NAME", "USER", "STATE",
                             "EXIT", "WALL")))
+    if res.truncated and last_id:
+        print(f"# limited to {args.limit}; continue with "
+              f"--after {last_id}")
     return 0
 
 
@@ -869,6 +890,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--user", "-u", default="")
     p.add_argument("--partition", "-p", default="")
     p.add_argument("--history", action="store_true")
+    p.add_argument("--limit", "-L", type=int, default=0,
+                   help="page size (0 = everything)")
+    p.add_argument("--after", type=int, default=0,
+                   help="resume after this job id (keyset cursor)")
     p.set_defaults(func=cmd_cqueue)
 
     p = sub.add_parser("cinfo", help="show cluster nodes")
@@ -891,6 +916,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("cacct", help="show accounting history")
     p.add_argument("--user", "-u", default="")
+    p.add_argument("--limit", "-L", type=int, default=0,
+                   help="page size (0 = everything)")
+    p.add_argument("--after", type=int, default=0,
+                   help="resume after this job id (keyset cursor)")
     p.set_defaults(func=cmd_cacct)
 
     p = sub.add_parser("cnode", help="node control (drain/resume/...)")

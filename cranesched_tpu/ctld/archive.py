@@ -75,8 +75,15 @@ class JobArchive:
             self._db.commit()
 
     def query(self, job_ids=(), user: str = "", partition: str = "",
-              limit: int = 0) -> list[Job]:
-        """Filterable history read (newest first)."""
+              limit: int = 0, after_job_id: int = 0,
+              keyset: bool = False) -> list[Job]:
+        """Filterable history read.  Default order is newest first;
+        with ``keyset`` (or a nonzero ``after_job_id``) the read
+        becomes a keyset page (ascending job id, strictly after the
+        cursor — 0 = from the start) so pagination reaches EVERY
+        archived row: applying the cursor post-hoc to a newest-first
+        capped read would silently hide everything past the cap."""
+        keyset = keyset or bool(after_job_id)
         clauses, params = [], []
         if job_ids:
             clauses.append("job_id IN (%s)"
@@ -88,10 +95,14 @@ class JobArchive:
         if partition:
             clauses.append("partition = ?")
             params.append(partition)
+        if after_job_id:
+            clauses.append("job_id > ?")
+            params.append(int(after_job_id))
         sql = "SELECT record FROM jobs"
         if clauses:
             sql += " WHERE " + " AND ".join(clauses)
-        sql += " ORDER BY end_time DESC, job_id DESC"
+        sql += (" ORDER BY job_id ASC" if keyset
+                else " ORDER BY end_time DESC, job_id DESC")
         if limit:
             sql += " LIMIT ?"
             params.append(int(limit))

@@ -8,6 +8,12 @@ from cranesched_tpu.rpc.consts import SERVICE
 from cranesched_tpu.rpc.stub import GrpcStub
 
 
+class StreamResult:
+    """Out-parameter for streaming queries: did the server truncate?"""
+
+    truncated = False
+
+
 class CtldClient:
     def __init__(self, address: str, timeout: float = 30.0,
                  token: str = "", tls=None):
@@ -65,13 +71,35 @@ class CtldClient:
                           pb.OkReply)
 
     def query_jobs(self, job_ids=(), user: str = "", partition: str = "",
-                   include_history: bool = False) -> pb.QueryJobsReply:
+                   include_history: bool = False, limit: int = 0,
+                   after_job_id: int = 0) -> pb.QueryJobsReply:
         return self._call(
             "QueryJobsInfo",
             pb.QueryJobsRequest(job_ids=list(job_ids), user=user,
                                 partition=partition,
-                                include_history=include_history),
+                                include_history=include_history,
+                                limit=limit,
+                                after_job_id=after_job_id),
             pb.QueryJobsReply)
+
+    def query_jobs_stream(self, job_ids=(), user: str = "",
+                          partition: str = "",
+                          include_history: bool = False,
+                          limit: int = 0, after_job_id: int = 0,
+                          result=None):
+        """Yield JobInfo messages from the server-streaming query
+        (chunked on the wire; flattened here).  Pass a
+        ``StreamResult`` as ``result`` to learn whether the server
+        truncated (more rows exist past the last yielded id)."""
+        request = pb.QueryJobsRequest(
+            job_ids=list(job_ids), user=user, partition=partition,
+            include_history=include_history, limit=limit,
+            after_job_id=after_job_id)
+        for reply in self._stub.call_stream("QueryJobsStream", request,
+                                            pb.QueryJobsReply):
+            if reply.truncated and result is not None:
+                result.truncated = True
+            yield from reply.jobs
 
     def query_cluster(self) -> pb.QueryClusterReply:
         return self._call("QueryClusterInfo", pb.QueryClusterRequest(),

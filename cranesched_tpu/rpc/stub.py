@@ -37,5 +37,23 @@ class GrpcStub:
                     else None)
         return stub(request, timeout=self.timeout, metadata=metadata)
 
+    # server streams drain large result sets across many scheduler
+    # cycles — the unary timeout (30 s) would abort them mid-stream
+    STREAM_TIMEOUT = 600.0
+
+    def call_stream(self, name, request, reply_cls):
+        """Server-streaming call: yields reply messages."""
+        stub = self._stubs.get(("stream", name))
+        if stub is None:
+            stub = self._channel.unary_stream(
+                f"/{self.service}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=reply_cls.FromString)
+            self._stubs[("stream", name)] = stub
+        metadata = ((("crane-token", self.token),) if self.token
+                    else None)
+        return stub(request, timeout=self.STREAM_TIMEOUT,
+                    metadata=metadata)
+
     def close(self) -> None:
         self._channel.close()

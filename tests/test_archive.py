@@ -179,3 +179,32 @@ def test_job_ids_never_reused_over_archived_history(tmp_path):
     sched2.schedule_cycle(now=21.0)
     rows = {j.spec.name for j in archive2.query()}
     assert rows == {"first", "second"}      # both survive
+
+
+def test_archive_keyset_pagination(tmp_path):
+    """Keyset mode reads ascending from a cursor (0 = start) so paged
+    cacct reaches every archived row (review r4: the newest-first cap
+    hid older history from paginated reads)."""
+    import time
+
+    from cranesched_tpu.ctld.defs import Job
+
+    archive = JobArchive(str(tmp_path / "h.sqlite"))
+    now = time.time()
+    for jid in range(1, 31):
+        archive.append(Job(job_id=jid, spec=JobSpec(user="u"),
+                           submit_time=now, status=JobStatus.COMPLETED,
+                           start_time=now, end_time=now + jid,
+                           exit_code=0))
+    # default read: newest first, capped
+    assert [j.job_id for j in archive.query(limit=5)] == \
+        [30, 29, 28, 27, 26]
+    # keyset walk from the start drains all 30, ascending
+    seen, cursor = [], 0
+    while True:
+        page = archive.query(limit=7, after_job_id=cursor, keyset=True)
+        if not page:
+            break
+        seen += [j.job_id for j in page]
+        cursor = page[-1].job_id
+    assert seen == list(range(1, 31))

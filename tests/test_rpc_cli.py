@@ -213,3 +213,66 @@ def test_steps_over_wire(ctld):
     assert jobs[0].status == "Completed"
     # rejected: no such allocation anymore
     assert client.submit_step(jid, pb.StepSpec(name="late")).step_id == -1
+
+
+def test_streaming_and_paginated_queries(ctld):
+    """QueryJobsStream chunks + keyset pagination (reference streams
+    QueryJobsInfo, Crane.proto:1576-1590; VERDICT r3 missing #10)."""
+    client, server, sched, _ = ctld
+    ids = [client.submit(job_spec(name=f"j{i}")).job_id
+           for i in range(25)]
+    assert len(set(ids)) == 25
+    server.QUERY_CHUNK = 10  # force multiple chunks on the wire
+
+    streamed = [j.job_id for j in client.query_jobs_stream()]
+    assert streamed == sorted(ids)
+
+    # keyset pagination, unary: limit + truncated flag + cursor
+    page1 = client.query_jobs(limit=10)
+    assert len(page1.jobs) == 10 and page1.truncated
+    page2 = client.query_jobs(limit=10,
+                              after_job_id=page1.jobs[-1].job_id)
+    assert len(page2.jobs) == 10 and page2.truncated
+    page3 = client.query_jobs(limit=10,
+                              after_job_id=page2.jobs[-1].job_id)
+    assert len(page3.jobs) == 5 and not page3.truncated
+    walked = [j.job_id for p in (page1, page2, page3) for j in p.jobs]
+    assert walked == sorted(ids)
+
+    # streamed with limit honors the cap
+    capped = [j.job_id for j in client.query_jobs_stream(limit=7)]
+    assert capped == sorted(ids)[:7]
+
+    # filters still compose with the stream
+    only = [j.job_id for j in client.query_jobs_stream(
+        job_ids=[ids[3], ids[7]])]
+    assert only == sorted([ids[3], ids[7]])
+
+
+def test_stream_truncated_flag_and_cursor(ctld):
+    from cranesched_tpu.rpc.client import StreamResult
+    client, server, sched, _ = ctld
+    ids = [client.submit(job_spec()).job_id for i in range(12)]
+
+    res = StreamResult()
+    got = [j.job_id for j in client.query_jobs_stream(limit=5,
+                                                      result=res)]
+    assert got == sorted(ids)[:5] and res.truncated
+
+    # exactly-full final page: no spurious truncation
+    res2 = StreamResult()
+    got2 = [j.job_id for j in client.query_jobs_stream(
+        limit=12, result=res2)]
+    assert got2 == sorted(ids) and not res2.truncated
+
+    # cursor walk drains everything
+    seen, cursor = [], 0
+    while True:
+        r = StreamResult()
+        page = [j.job_id for j in client.query_jobs_stream(
+            limit=5, after_job_id=cursor, result=r)]
+        seen += page
+        if not r.truncated:
+            break
+        cursor = page[-1]
+    assert seen == sorted(ids)
