@@ -568,6 +568,7 @@ class CranedDaemon:
         # every member can enumerate the gang and find the coordinator.
         # Per-REQUEST values (rank differs per node; a step's span can
         # be a subset of the allocation's).
+        rdzv_serve_port = 0
         if request.nodelist:
             step_env["CRANE_JOB_NODELIST"] = request.nodelist
             step_env["CRANE_NODE_RANK"] = str(request.node_rank)
@@ -575,6 +576,15 @@ class CranedDaemon:
             step_env["CRANE_NTASKS"] = str(request.ntasks)
             if request.rendezvous:
                 step_env["CRANE_RENDEZVOUS"] = request.rendezvous
+                if request.rendezvous_token:
+                    step_env["CRANE_RENDEZVOUS_TOKEN"] = \
+                        request.rendezvous_token
+                # the rank-0 supervisor HOSTS the gang's fence/modex
+                # service at the advertised port (the PMIx-server
+                # role, Pmix.h:44)
+                if request.node_rank == 0 and request.nnodes > 1:
+                    rdzv_serve_port = int(
+                        request.rendezvous.rsplit(":", 1)[1])
         step_env["CRANE_NTASKS_ON_NODE"] = str(request.tasks_on_node
                                                or 1)
         # the supervisor must import this package regardless of workdir
@@ -633,7 +643,9 @@ class CranedDaemon:
             container=self._container_doc(
                 job_id, step_id, image, mounts, alloc,
                 step_spec.res if step_spec and step_spec.HasField("res")
-                else spec.res) if image else None)
+                else spec.res) if image else None,
+            rendezvous_serve=rdzv_serve_port,
+            rendezvous_token=request.rendezvous_token or "")
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()

@@ -328,6 +328,23 @@ def main() -> int:
             report(f"PROLOGFAIL {rc}")
             return 0
 
+    # rank-0 of a multi-node gang hosts the fence/modex service at the
+    # advertised rendezvous port (the PMIx-server role, Pmix.h:44).
+    # Lives and dies with this supervisor; a bind failure (port taken
+    # on this host) degrades to no service — members' fences then time
+    # out with a legible error rather than hanging forever
+    rdzv = None
+    if init.get("rendezvous_serve"):
+        from cranesched_tpu.rpc.rendezvous import RendezvousServer
+        rdzv = RendezvousServer(
+            token=init.get("rendezvous_token") or "",
+            nranks=int(env.get("CRANE_NNODES") or 1))
+        try:
+            rdzv.start(f"0.0.0.0:{init['rendezvous_serve']}")
+        except Exception as exc:
+            print(f"rendezvous bind failed: {exc}", file=sys.stderr)
+            rdzv = None
+
     container = init.get("container")
     argv = _child_argv(script, env, container,
                        interactive=interactive is not None,
@@ -433,6 +450,10 @@ def main() -> int:
                 pass
             child.wait()
             _container_rm(container)
+            if rdzv is not None:
+                rdzv.stop()   # releases parked fences: their handler
+                              # threads are non-daemon and would pin
+                              # this process past its own exit
             if interactive is not None:
                 interactive.finish(124)
             suffix = ""
@@ -442,6 +463,9 @@ def main() -> int:
             report("TIMEOUT" + suffix)
             return 0
 
+    if rdzv is not None:
+        rdzv.stop()   # see the timeout path: parked fences must not
+                      # pin the supervisor's exit
     if interactive is not None:
         # readers drained + exited chunk sent BEFORE the craned report:
         # the client always has the full output when the exit lands

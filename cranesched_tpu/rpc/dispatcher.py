@@ -128,7 +128,8 @@ class GrpcDispatcher:
                     node_rank=gang["rank"][node_id],
                     nnodes=len(node_ids),
                     ntasks=gang["ntasks"],
-                    rendezvous=gang["rendezvous"])
+                    rendezvous=gang["rendezvous"],
+                    rendezvous_token=gang["token"])
                 if step_pb is not None:
                     req.step.CopyFrom(step_pb)
                 try:
@@ -187,6 +188,7 @@ class GrpcDispatcher:
         incarnation = self.scheduler.running[job_id].requeue_count \
             if job_id in self.scheduler.running else 0
         import hashlib
+        import secrets
         digest = hashlib.blake2b(
             f"{job_id}/{step_id}/{incarnation}".encode(),
             digest_size=8).digest()
@@ -196,6 +198,9 @@ class GrpcDispatcher:
             "rank": {n: i for i, n in enumerate(node_ids)},
             "ntasks": ntasks,
             "rendezvous": f"{names[0]}:{port}" if names else "",
+            # gates the rank-0 fence/modex service: unguessable,
+            # one per dispatched gang
+            "token": secrets.token_urlsafe(12),
         }
 
     def dispatch_step(self, job: Job, step) -> None:
@@ -225,7 +230,8 @@ class GrpcDispatcher:
                     node_rank=gang["rank"][node_id],
                     nnodes=len(node_ids),
                     ntasks=gang["ntasks"],
-                    rendezvous=gang["rendezvous"])
+                    rendezvous=gang["rendezvous"],
+                    rendezvous_token=gang["token"])
                 req.step.CopyFrom(step_pb)
                 try:
                     reply = stub.call("ExecuteStep", req)

@@ -11,13 +11,17 @@ import grpc
 
 class GrpcStub:
     def __init__(self, address: str, service: str, timeout: float = 30.0,
-                 token: str = "", tls=None):
+                 token: str = "", tls=None,
+                 token_key: str = "crane-token"):
         self.address = address
         self.service = service
         self.timeout = timeout
         # bearer token attached as metadata on every call (verified by
-        # the ctld's AuthManager; empty = unauthenticated)
+        # the ctld's AuthManager; empty = unauthenticated).  token_key
+        # lets other services on this plumbing use their own header
+        # (e.g. the rendezvous service's per-gang secret)
         self.token = token
+        self.token_key = token_key
         if tls is not None:
             from cranesched_tpu.utils.pki import secure_channel
             self._channel = secure_channel(address, tls)
@@ -25,7 +29,7 @@ class GrpcStub:
             self._channel = grpc.insecure_channel(address)
         self._stubs = {}
 
-    def call(self, name, request, reply_cls):
+    def call(self, name, request, reply_cls, timeout: float | None = None):
         stub = self._stubs.get(name)
         if stub is None:
             stub = self._channel.unary_unary(
@@ -33,9 +37,10 @@ class GrpcStub:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=reply_cls.FromString)
             self._stubs[name] = stub
-        metadata = ((("crane-token", self.token),) if self.token
+        metadata = (((self.token_key, self.token),) if self.token
                     else None)
-        return stub(request, timeout=self.timeout, metadata=metadata)
+        return stub(request, timeout=timeout or self.timeout,
+                    metadata=metadata)
 
     # server streams drain large result sets across many scheduler
     # cycles — the unary timeout (30 s) would abort them mid-stream
