@@ -643,6 +643,45 @@ def cmd_cacct(args) -> int:
     return 0
 
 
+def cmd_ceff(args) -> int:
+    """Job efficiency report (reference ceff via
+    PluginQueryService::QueryJobEfficiency, Crane.proto:1615-1617):
+    allocated vs consumed CPU and memory from the per-step usage
+    samples the supervisors reported."""
+    client = _client(args)
+    jobs = client.query_jobs(job_ids=[args.job_id],
+                             include_history=True).jobs
+    if not jobs:
+        print(f"ceff: no such job {args.job_id}", file=sys.stderr)
+        return 1
+    j = jobs[0]
+    wall = (j.end_time - j.start_time
+            if j.end_time and j.start_time else 0.0)
+    steps = client.query_steps(args.job_id).steps
+    print(f"Job {j.job_id} ({j.name}) user={j.user} state={j.status}")
+    print(f"  nodes: {','.join(j.node_names) or '-'}")
+    print(f"  wall time: {wall:.1f}s")
+    print(f"  cpu used: {j.cpu_seconds:.1f} core-seconds")
+    # allocated core-seconds: per-node cpu share x nodes x wall
+    # (cpu_total from the cluster query is not needed — the job info
+    # itself doesn't carry the request, so derive from usage when
+    # possible and report what is known)
+    if wall > 0 and j.cpu_seconds > 0:
+        n_nodes = max(len(j.node_names), 1)
+        print(f"  cpu efficiency: "
+              f"{100.0 * j.cpu_seconds / (wall * n_nodes):.1f}% "
+              f"(vs {n_nodes} node-cores-seconds; multiply by the "
+              f"per-node core count for absolute efficiency)")
+    if j.max_rss_bytes:
+        print(f"  peak RSS: {j.max_rss_bytes / (1 << 20):.1f} MiB")
+    for s in steps:
+        if s.cpu_seconds or s.max_rss_bytes:
+            print(f"  step {s.step_id}: cpu={s.cpu_seconds:.1f}s "
+                  f"rss={s.max_rss_bytes / (1 << 20):.1f}MiB "
+                  f"({s.status})")
+    return 0
+
+
 def cmd_cacctmgr(args) -> int:
     import json as _json
     client = _client(args)
@@ -921,6 +960,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--after", type=int, default=0,
                    help="resume after this job id (keyset cursor)")
     p.set_defaults(func=cmd_cacct)
+
+    p = sub.add_parser("ceff", help="job efficiency (cpu/memory)")
+    p.add_argument("job_id", type=int)
+    p.set_defaults(func=cmd_ceff)
 
     p = sub.add_parser("cnode", help="node control (drain/resume/...)")
     p.add_argument("action",

@@ -90,18 +90,26 @@ def get(key: str, timeout: float = 60.0) -> bytes | None:
         client.close()
 
 
-def jax_coordinator(timeout: float = 120.0) -> str:
+def jax_coordinator(timeout: float = 120.0, port: int = 0) -> str:
     """Coordinator address for ``jax.distributed.initialize`` (or any
-    torchrun-style bootstrap): rank 0 binds a free port on its host
-    and publishes it via the modex; everyone else reads it."""
+    torchrun-style bootstrap): rank 0 picks a port on its host and
+    publishes it via the modex; everyone else reads it.
+
+    With ``port=0`` rank 0 probes a free ephemeral port — the probe
+    socket closes before the framework rebinds it, so a racing
+    process can still steal it in that window (narrow, not zero).
+    Deployments that manage ports should pass an explicit ``port``."""
     if nranks() <= 1:
         return "127.0.0.1:0"
     if rank() == 0:
         host = os.environ.get("CRANE_RENDEZVOUS", "").split(":")[0] \
             or socket.gethostname()
-        with socket.socket() as s:
-            s.bind(("", 0))
-            port = s.getsockname()[1]
+        if not port:
+            with socket.socket() as s:
+                s.setsockopt(socket.SOL_SOCKET,
+                             socket.SO_REUSEADDR, 1)
+                s.bind(("", 0))
+                port = s.getsockname()[1]
         addr = f"{host}:{port}"
         put("crane/jax_coordinator", addr.encode())
         return addr

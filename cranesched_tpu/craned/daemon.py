@@ -22,6 +22,7 @@ from __future__ import annotations
 import enum
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -877,6 +878,13 @@ class CranedDaemon:
         # failing prolog is its own report (step failed before the user
         # command ran, node drains)
         hook_drain = ""
+        # efficiency sample rides the report tail (strip FIRST: it is
+        # always the last token group)
+        cpu_seconds, max_rss = 0.0, 0
+        m = re.search(r" USAGE cpu=([\d.]+) rss=(\d+)$", report)
+        if m:
+            cpu_seconds, max_rss = float(m.group(1)), int(m.group(2))
+            report = report[: m.start()]
         if report.endswith(" EPILOGFAIL"):
             report = report[: -len(" EPILOGFAIL")]
             hook_drain = "epilog failed"
@@ -911,7 +919,9 @@ class CranedDaemon:
                                           if self.node_id is not None
                                           else -1,
                                           incarnation=step.incarnation,
-                                          step_id=step.step_id)
+                                          step_id=step.step_id,
+                                          cpu_seconds=cpu_seconds,
+                                          max_rss_bytes=max_rss)
         except (grpc.RpcError, ValueError):
             pass  # ctld down / client closed: the ping timeout + WAL
                   # reconcile at re-registration

@@ -480,11 +480,59 @@ def main() -> int:
         if _run_hook(epilog, env, out) != 0:
             epilog_suffix = " EPILOGFAIL"
 
+    usage_suffix = _usage_suffix(init)
     if state["terminated"]:
-        report("KILLED" + epilog_suffix)
+        report("KILLED" + epilog_suffix + usage_suffix)
     else:
-        report(f"EXIT {code}{epilog_suffix}")
+        report(f"EXIT {code}{epilog_suffix}{usage_suffix}")
     return 0
+
+
+def _usage_suffix(init: dict) -> str:
+    """Efficiency sample at step end (the ceff data source; reference
+    answers ceff through the plugin daemon, Crane.proto:1615-1617):
+    cpu-seconds and peak RSS from the job cgroup where one exists,
+    else from getrusage(RUSAGE_CHILDREN).  Always the LAST report
+    tokens; a failure to sample reports nothing rather than failing
+    the step."""
+    import resource
+    cpu = 0.0
+    rss = 0
+    try:
+        ru = resource.getrusage(resource.RUSAGE_CHILDREN)
+        cpu = ru.ru_utime + ru.ru_stime
+        rss = ru.ru_maxrss * 1024   # Linux reports KiB
+    except OSError:
+        pass
+    procs = init.get("cgroup_procs")
+    for pp in ([procs] if isinstance(procs, str) else procs or []):
+        d = os.path.dirname(pp)
+        # v2 unified dir or the v1 memory/cpu controller dirs
+        for fname, kind in (("memory.peak", "rss"),
+                            ("memory.max_usage_in_bytes", "rss"),
+                            ("cpu.stat", "cpu")):
+            path = os.path.join(d, fname)
+            try:
+                with open(path) as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            if kind == "rss":
+                try:
+                    rss = max(rss, int(text.strip()))
+                except ValueError:
+                    pass
+            else:
+                for line in text.splitlines():
+                    if line.startswith("usage_usec"):
+                        try:
+                            cpu = max(cpu,
+                                      int(line.split()[1]) / 1e6)
+                        except (ValueError, IndexError):
+                            pass
+    if cpu <= 0 and rss <= 0:
+        return ""
+    return f" USAGE cpu={cpu:.3f} rss={rss}"
 
 
 if __name__ == "__main__":

@@ -62,7 +62,12 @@ def main(argv=None) -> int:
                     default=os.environ.get("CRANE_TLS_NAME", "ctld"),
                     help="name the ctld's cert is issued under "
                          "(identity pin for the dial; default ctld)")
+    ap.add_argument("--log-file", default="",
+                    help="rotating log file (32 MiB x 5 by default)")
+    ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
+    from cranesched_tpu.utils.logging import setup_logging
+    setup_logging("craned", args.log_file, args.log_level)
     if args.tls_ca and not (args.tls_cert and args.tls_key):
         ap.error("--tls-ca requires --tls-cert and --tls-key "
                  "(a CA-only craned would serve a plaintext push "

@@ -190,6 +190,9 @@ class Step:
     node_reports: dict[int, tuple] = dataclasses.field(
         default_factory=dict)
     cancel_requested: bool = False
+    # efficiency sample (ceff): summed over the step's nodes / peak
+    cpu_seconds: float = 0.0
+    max_rss_bytes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,6 +288,10 @@ class Job:
     node_reports: dict[int, tuple] = dataclasses.field(
         default_factory=dict)
     requeue_count: int = 0
+    # efficiency accounting (ceff): summed cpu-seconds across all step
+    # reports and the peak RSS any of them observed
+    cpu_seconds: float = 0.0
+    max_rss_bytes: int = 0
     # dependency edge state: dep job_id -> earliest satisfiable time, or
     # DEP_NEVER (event-driven, reference AddDependent /
     # TriggerTerminalDependencyEvents, CtldPublicDefs.cpp:1750-1775)

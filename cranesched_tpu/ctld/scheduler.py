@@ -1123,7 +1123,9 @@ class JobScheduler:
 
     def step_report(self, job_id: int, step_id: int, status: StepStatus,
                     exit_code: int, now: float, node_id: int = -1,
-                    incarnation: int | None = None) -> None:
+                    incarnation: int | None = None,
+                    cpu_seconds: float = 0.0,
+                    max_rss_bytes: int = 0) -> None:
         """Per-step status report from a craned (or whole-step from the
         sim).  Steps aggregate per-node exactly like jobs; a terminal
         step frees its internal share and pulls the next pending step
@@ -1137,9 +1139,25 @@ class JobScheduler:
         step = job.steps.get(step_id)
         if step is None or step.status.is_terminal:
             return
+
+        def fold_usage():
+            # efficiency accounting (ceff): cpu-seconds sum across
+            # node reports, RSS keeps the peak; the job aggregates its
+            # steps.  Folded only for ACCEPTED first-time reports —
+            # a re-delivered or rejected report must not inflate ceff
+            if cpu_seconds or max_rss_bytes:
+                step.cpu_seconds += cpu_seconds
+                step.max_rss_bytes = max(step.max_rss_bytes,
+                                         max_rss_bytes)
+                job.cpu_seconds += cpu_seconds
+                job.max_rss_bytes = max(job.max_rss_bytes,
+                                        max_rss_bytes)
+
         if node_id >= 0:
             if node_id not in step.node_ids:
                 return
+            if node_id not in step.node_reports:
+                fold_usage()
             is_failure = status not in (StepStatus.COMPLETED,
                                         StepStatus.CANCELLED)
             had_failure = any(
@@ -1151,6 +1169,9 @@ class JobScheduler:
             if not all(n in step.node_reports for n in step.node_ids):
                 return
             status, exit_code = self._aggregate_step(step)
+        else:
+            fold_usage()   # whole-step (sim) form: accepted exactly
+                           # once — the step turns terminal below
         step.status = status
         step.end_time = now
         step.exit_code = exit_code

@@ -114,6 +114,8 @@ def _job_to_dict(job: Job) -> dict:
         "suspend_time": job.suspend_time,
         "suspended_total": job.suspended_total,
         "next_step_id": job.next_step_id,
+        "cpu_seconds": job.cpu_seconds,
+        "max_rss_bytes": job.max_rss_bytes,
         "steps": [_step_to_dict(s) for s in job.steps.values()],
     }
 
@@ -134,6 +136,8 @@ def _step_to_dict(step: Step) -> dict:
         "node_reports": {str(k): [v[0].name, v[1]]
                          for k, v in step.node_reports.items()},
         "cancel_requested": step.cancel_requested,
+        "cpu_seconds": step.cpu_seconds,
+        "max_rss_bytes": step.max_rss_bytes,
     }
 
 
@@ -153,6 +157,8 @@ def _step_from_dict(d: dict) -> Step:
         node_reports={int(k): (StepStatus[v[0]], v[1])
                       for k, v in (d.get("node_reports") or {}).items()},
         cancel_requested=d.get("cancel_requested", False),
+        cpu_seconds=d.get("cpu_seconds", 0.0),
+        max_rss_bytes=d.get("max_rss_bytes", 0),
     )
 
 
@@ -188,6 +194,8 @@ def _job_from_dict(d: dict) -> Job:
         suspend_time=d.get("suspend_time"),
         suspended_total=d.get("suspended_total", 0.0),
         next_step_id=d.get("next_step_id", 0),
+        cpu_seconds=d.get("cpu_seconds", 0.0),
+        max_rss_bytes=d.get("max_rss_bytes", 0),
         steps={s["step_id"]: _step_from_dict(s)
                for s in (d.get("steps") or ())},
     )

@@ -43,7 +43,14 @@ def main(argv=None) -> int:
     ap.add_argument("--listen", default="",
                     help="override the config listen address")
     ap.add_argument("--cycle-interval", type=float, default=1.0)
+    ap.add_argument("--log-file", default="",
+                    help="rotating log file (32 MiB x 5 by default)")
+    ap.add_argument("--log-level", default="info")
     args = ap.parse_args(argv)
+
+    from cranesched_tpu.utils.logging import setup_logging
+    log = setup_logging("ctld", args.log_file, args.log_level)
+    log.info("cranectld starting (config=%s)", args.config)
 
     from cranesched_tpu.craned.sim import SimCluster
     from cranesched_tpu.ctld.wal import WriteAheadLog
@@ -119,10 +126,24 @@ def main(argv=None) -> int:
           f"{len(meta.nodes)} nodes configured"
           f"{', TLS' if tls else ''})", flush=True)
 
+    syncer = None
+    if cfg.license_sync.get("Program"):
+        from cranesched_tpu.ctld.licenses import LicenseSyncer
+        syncer = LicenseSyncer(
+            scheduler.licenses, str(cfg.license_sync["Program"]),
+            interval=float(cfg.license_sync.get("Interval", 60)),
+            lock=server._lock)
+        syncer.sync_once()   # first observation before the first cycle
+        syncer.start()
+        print(f"license sync: {cfg.license_sync['Program']} "
+              f"every {syncer.interval:g}s", flush=True)
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if syncer is not None:
+        syncer.stop()
     server.stop()
     if dispatcher is not None:
         dispatcher.close()

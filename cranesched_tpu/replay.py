@@ -71,7 +71,78 @@ def _drain(sched, sim, max_cycles=100_000):
     )
 
 
-def replay_fifo(scale: float, rng):
+def _run_direct(sched, sim, specs, max_cycles=100_000):
+    """Library-call path: submit synchronously, drain on the virtual
+    clock (the round-1..3 replay shape)."""
+    for spec in specs:
+        sched.submit(spec, now=0.0)
+    return _drain(sched, sim, max_cycles=max_cycles)
+
+
+def _run_rpc(sched, sim, specs, wal_path: str | None = None,
+             max_cycles=100_000):
+    """The FULL control-plane path (VERDICT r3 #10): every job enters
+    through SubmitBatchJobs over gRPC, lands in the WAL, is placed by
+    the cycle, and dispatches to the sim plane; cycles advance through
+    the Tick RPC."""
+    from cranesched_tpu.ctld.wal import WriteAheadLog
+    from cranesched_tpu.rpc import CtldClient, serve
+    from cranesched_tpu.rpc.convert import spec_to_pb
+
+    specs = [spec_to_pb(s) for s in specs]
+    if wal_path:
+        # fresh WAL per run: the log opens append-mode, and replay
+        # configs restart job ids at 1 — mixing runs in one file would
+        # merge unrelated benchmarks under last-writer-wins
+        open(wal_path, "w").close()
+        sched.wal = WriteAheadLog(wal_path)
+    server, port = serve(sched, sim=sim, tick_mode=True)
+    client = CtldClient(f"127.0.0.1:{port}", timeout=300.0)
+    t0 = time.perf_counter()
+    submitted = 0
+    for lo in range(0, len(specs), 1000):
+        replies = client.submit_many(specs[lo:lo + 1000]).replies
+        submitted += sum(1 for r in replies if r.job_id)
+    t_submit = time.perf_counter() - t0
+    cycle_ms = []
+    now = 0.0
+    try:
+        for _ in range(max_cycles):
+            c0 = time.perf_counter()
+            client.tick(now)
+            cycle_ms.append((time.perf_counter() - c0) * 1e3)
+            if not sched.running and not sched.pending:
+                break
+            now += 1.0
+    finally:
+        client.close()
+        server.stop()
+        if sched.wal is not None:
+            sched.wal.close()
+            sched.wal = None
+    wall = time.perf_counter() - t0
+    total = len(sched.history)
+    arr = np.asarray(cycle_ms) if cycle_ms else np.zeros(1)
+    return dict(
+        mode="rpc+wal" if wal_path else "rpc",
+        jobs_submitted=submitted,
+        submit_wall_s=round(t_submit, 3),
+        submit_jobs_per_s=round(submitted / t_submit, 1)
+        if t_submit else 0.0,
+        jobs_finished=total,
+        completed=sum(1 for j in sched.history.values()
+                      if j.status.value == "Completed"),
+        virtual_drain_s=now,
+        wall_s=round(wall, 3),
+        cycles=len(cycle_ms),
+        cycle_ms_mean=round(float(arr.mean()), 2),
+        cycle_ms_p99=round(float(np.percentile(arr, 99)), 2),
+        cycle_ms_max=round(float(arr.max()), 2),
+        jobs_per_wall_s=round(total / wall, 1) if wall else 0.0,
+    )
+
+
+def replay_fifo(scale: float, rng, run=_run_direct):
     """BASELINE config #1: FIFO 10k jobs x 1k nodes (cpu+mem)."""
     from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
     n_nodes = max(int(1000 * scale), 4)
@@ -79,17 +150,17 @@ def replay_fifo(scale: float, rng):
     meta, sched, sim = _build(
         n_nodes, cpu=16, mem_gb=64,
         config_kw=dict(priority_type="basic", backfill=False))
-    for _ in range(n_jobs):
-        sched.submit(JobSpec(
-            res=ResourceSpec(cpu=float(rng.integers(1, 9)),
-                             mem_bytes=int(rng.integers(1, 17)) << 30,
-                             memsw_bytes=int(rng.integers(1, 17)) << 30),
-            time_limit=3600,
-            sim_runtime=float(rng.integers(10, 300))), now=0.0)
-    return _drain(sched, sim)
+    specs = [JobSpec(
+        res=ResourceSpec(cpu=float(rng.integers(1, 9)),
+                         mem_bytes=int(rng.integers(1, 17)) << 30,
+                         memsw_bytes=int(rng.integers(1, 17)) << 30),
+        time_limit=3600,
+        sim_runtime=float(rng.integers(10, 300)))
+        for _ in range(n_jobs)]
+    return run(sched, sim, specs)
 
 
-def replay_minload(scale: float, rng):
+def replay_minload(scale: float, rng, run=_run_direct):
     """BASELINE config #2: MinCpuTimeRatioFirst, 50k x 5k,
     multi-partition."""
     from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
@@ -99,19 +170,19 @@ def replay_minload(scale: float, rng):
     meta, sched, sim = _build(
         n_nodes, cpu=32, mem_gb=128, partitions=parts,
         config_kw=dict(priority_type="multifactor", backfill=False))
-    for _ in range(n_jobs):
-        sched.submit(JobSpec(
-            partition=parts[int(rng.integers(0, len(parts)))],
-            res=ResourceSpec(cpu=float(rng.integers(1, 17)),
-                             mem_bytes=int(rng.integers(1, 33)) << 30,
-                             memsw_bytes=int(rng.integers(1, 33)) << 30),
-            qos_priority=int(rng.integers(0, 4)) * 100,
-            time_limit=7200,
-            sim_runtime=float(rng.integers(30, 600))), now=0.0)
-    return _drain(sched, sim)
+    specs = [JobSpec(
+        partition=parts[int(rng.integers(0, len(parts)))],
+        res=ResourceSpec(cpu=float(rng.integers(1, 17)),
+                         mem_bytes=int(rng.integers(1, 33)) << 30,
+                         memsw_bytes=int(rng.integers(1, 33)) << 30),
+        qos_priority=int(rng.integers(0, 4)) * 100,
+        time_limit=7200,
+        sim_runtime=float(rng.integers(30, 600)))
+        for _ in range(n_jobs)]
+    return run(sched, sim, specs)
 
 
-def replay_backfill(scale: float, rng):
+def replay_backfill(scale: float, rng, run=_run_direct):
     """BASELINE config #3: priority + backfill — short jobs around
     long high-priority blockers."""
     from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
@@ -121,9 +192,10 @@ def replay_backfill(scale: float, rng):
         n_nodes, cpu=16, mem_gb=64,
         config_kw=dict(priority_type="multifactor", backfill=True,
                        time_resolution=60.0, time_buckets=32))
+    specs = []
     for i in range(n_jobs):
         big = i % 10 == 0
-        sched.submit(JobSpec(
+        specs.append(JobSpec(
             res=ResourceSpec(cpu=16.0 if big else
                              float(rng.integers(1, 5)),
                              mem_bytes=(32 if big else 2) << 30,
@@ -131,11 +203,11 @@ def replay_backfill(scale: float, rng):
             qos_priority=1000 if big else 0,
             time_limit=1800 if big else 300,
             sim_runtime=float(rng.integers(600, 1800)) if big
-            else float(rng.integers(10, 120))), now=0.0)
-    return _drain(sched, sim)
+            else float(rng.integers(10, 120))))
+    return run(sched, sim, specs)
 
 
-def replay_gres(scale: float, rng):
+def replay_gres(scale: float, rng, run=_run_direct):
     """BASELINE config #4: GRES gang jobs (gpu slots, multi-node)."""
     from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
     n_nodes = max(int(1000 * scale), 8)
@@ -144,9 +216,10 @@ def replay_gres(scale: float, rng):
         n_nodes, cpu=32, mem_gb=128, layout_gres=[("gpu", "a100")],
         config_kw=dict(priority_type="multifactor", backfill=False,
                        max_nodes_per_job=4))
+    specs = []
     for _ in range(n_jobs):
         wants_gpu = rng.random() < 0.4
-        sched.submit(JobSpec(
+        specs.append(JobSpec(
             res=ResourceSpec(
                 cpu=float(rng.integers(1, 9)),
                 mem_bytes=int(rng.integers(1, 17)) << 30,
@@ -156,11 +229,11 @@ def replay_gres(scale: float, rng):
             node_num=int(rng.integers(1, 4)) if rng.random() < 0.2
             else 1,
             time_limit=3600,
-            sim_runtime=float(rng.integers(30, 300))), now=0.0)
-    return _drain(sched, sim)
+            sim_runtime=float(rng.integers(30, 300))))
+    return run(sched, sim, specs)
 
 
-def replay_qos(scale: float, rng):
+def replay_qos(scale: float, rng, run=_run_direct):
     """BASELINE config #5 (scaled from the 1M x 100k trace shape):
     QoS/fair-share mix with run limits across accounts."""
     from cranesched_tpu.ctld.accounting import (
@@ -184,17 +257,18 @@ def replay_qos(scale: float, rng):
         n_nodes, cpu=16, mem_gb=64, accounts=mgr,
         config_kw=dict(priority_type="multifactor", backfill=False))
     accounts = ("physics", "biology", "ml")
+    specs = []
     for _ in range(n_jobs):
         acc = accounts[int(rng.integers(0, 3))]
-        sched.submit(JobSpec(
+        specs.append(JobSpec(
             user=f"{acc}-u{int(rng.integers(0, 3))}", account=acc,
             qos="high" if rng.random() < 0.2 else "low",
             res=ResourceSpec(cpu=float(rng.integers(1, 5)),
                              mem_bytes=int(rng.integers(1, 9)) << 30,
                              memsw_bytes=int(rng.integers(1, 9)) << 30),
             time_limit=1800,
-            sim_runtime=float(rng.integers(10, 120))), now=0.0)
-    return _drain(sched, sim, max_cycles=200_000)
+            sim_runtime=float(rng.integers(10, 120))))
+    return run(sched, sim, specs, max_cycles=200_000)
 
 
 CONFIGS = {
@@ -213,13 +287,23 @@ def main(argv=None) -> int:
                     help="fraction of the full BASELINE shape")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--rpc", action="store_true",
+                    help="drive the FULL path: SubmitBatchJobs over "
+                         "gRPC -> WAL -> cycle -> dispatch")
+    ap.add_argument("--wal", default="",
+                    help="WAL path for --rpc (empty = no WAL)")
     args = ap.parse_args(argv)
+
+    run = _run_direct
+    if args.rpc:
+        import functools
+        run = functools.partial(_run_rpc, wal_path=args.wal or None)
 
     names = list(CONFIGS) if args.config == "all" else [args.config]
     results = {}
     for name in names:
         rng = np.random.default_rng(args.seed)
-        results[name] = CONFIGS[name](args.scale, rng)
+        results[name] = CONFIGS[name](args.scale, rng, run=run)
     if args.json:
         print(json.dumps(results))
     else:

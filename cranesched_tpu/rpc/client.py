@@ -165,11 +165,15 @@ class CtldClient:
 
     def step_status_change(self, job_id, status, exit_code, time,
                            node_id: int = -1, incarnation: int = 0,
-                           step_id: int | None = None) -> pb.OkReply:
+                           step_id: int | None = None,
+                           cpu_seconds: float = 0.0,
+                           max_rss_bytes: int = 0) -> pb.OkReply:
         req = pb.StepStatusChangeRequest(job_id=job_id, status=status,
                                          exit_code=exit_code, time=time,
                                          node_id=node_id,
-                                         incarnation=incarnation)
+                                         incarnation=incarnation,
+                                         cpu_seconds=cpu_seconds,
+                                         max_rss_bytes=max_rss_bytes)
         if step_id is not None:
             req.step_id = step_id
         return self._call("StepStatusChange", req, pb.OkReply)

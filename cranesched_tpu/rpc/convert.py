@@ -189,6 +189,8 @@ def step_to_pb(job_id: int, step: Step, node_names) -> pb.StepInfo:
         start_time=step.start_time or 0.0,
         end_time=step.end_time or 0.0,
         node_names=[_node_name(node_names, n) for n in step.node_ids],
+        cpu_seconds=step.cpu_seconds,
+        max_rss_bytes=step.max_rss_bytes,
     )
 
 
@@ -213,4 +215,6 @@ def job_to_pb(job: Job, node_names) -> pb.JobInfo:
         array_parent_id=job.array_parent_id or 0,
         array_task_id=(job.array_task_id
                        if job.array_task_id is not None else -1),
+        cpu_seconds=job.cpu_seconds,
+        max_rss_bytes=job.max_rss_bytes,
     )

@@ -505,8 +505,14 @@ class CtldServer:
         self._require_authenticated(self._ident(context), context)
         import json as _json
         with self._lock:
-            return pb.StatsReply(
-                json=_json.dumps(self.scheduler.stats))
+            doc = dict(self.scheduler.stats)
+            doc["licenses"] = {
+                name: {"total": lic.total, "in_use": lic.in_use,
+                       "external_used": lic.external_used,
+                       "free": lic.free, "remote": lic.remote}
+                for name, lic in
+                self.scheduler.licenses.licenses.items()}
+            return pb.StatsReply(json=_json.dumps(doc))
 
     def AcctMgr(self, request, context):
         """Accounting CRUD (reference cacctmgr -> AccountManager RPC
@@ -729,7 +735,9 @@ class CtldServer:
                     request.job_id, request.step_id,
                     StepStatus(request.status), request.exit_code,
                     request.time, node_id=request.node_id,
-                    incarnation=request.incarnation)
+                    incarnation=request.incarnation,
+                    cpu_seconds=request.cpu_seconds,
+                    max_rss_bytes=request.max_rss_bytes)
             else:
                 self.scheduler.step_status_change(
                     request.job_id, JobStatus(request.status),

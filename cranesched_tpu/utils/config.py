@@ -98,6 +98,10 @@ class CraneConfig:
     # 133-143): Tls: {Ca, Cert, Key, RequireClientCert} — empty Ca =
     # plaintext wire (sims, trusted loopback)
     tls: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # remote license reconciliation (reference server-synced licenses,
+    # LicenseManager.h:46-125): LicenseSync: {Program, Interval}
+    license_sync: dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
     def tls_config(self):
         """-> utils.pki.TlsConfig for the ctld server, or None."""
@@ -202,8 +206,9 @@ class CraneConfig:
         scheduler = JobScheduler(meta, config, submit_hook=hook,
                                  accounts=accounts)
         for lic in self.licenses:
-            scheduler.licenses.configure(str(lic["name"]),
-                                         int(lic["total"]))
+            scheduler.licenses.configure(
+                str(lic["name"]), int(lic.get("total", 0)),
+                remote=bool(lic.get("remote", False)))
         return meta, scheduler
 
 
@@ -292,4 +297,5 @@ def load_config(path: str) -> CraneConfig:
         auth_admins=[str(a) for a in
                      (raw.get("Auth") or {}).get("Admins", ["root"])],
         node_event_hook_path=str(raw.get("NodeEventHook", "") or ""),
-        tls=raw.get("Tls", {}) or {})
+        tls=raw.get("Tls", {}) or {},
+        license_sync=raw.get("LicenseSync", {}) or {})

@@ -141,3 +141,61 @@ def test_real_craned_health_program(tmp_path):
         d.stop()
         dispatcher.close()
         server.stop()
+
+
+def test_step_usage_flows_to_ceff_data(tmp_path):
+    """Efficiency samples (cpu-seconds, peak RSS) travel supervisor ->
+    craned report -> StepStatusChange -> Job/Step records (the ceff
+    data path; reference QueryJobEfficiency, Crane.proto:1615-1617)."""
+    import time
+
+    from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+    from cranesched_tpu.ctld import (
+        JobScheduler,
+        JobSpec,
+        JobStatus,
+        MetaContainer,
+        ResourceSpec,
+        SchedulerConfig,
+    )
+    from cranesched_tpu.rpc import serve
+    from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("eff0", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5,
+                     cgroup_root=str(tmp_path / "nocg"))
+    d.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and d.state != CranedState.READY:
+            time.sleep(0.05)
+        # burn a bit of cpu + allocate some memory so the sample is
+        # visibly nonzero
+        jid = sched.submit(JobSpec(
+            res=ResourceSpec(cpu=1.0),
+            script="python3 -c 'x=bytearray(30<<20); s=0\n"
+                   "for i in range(2_000_00): s+=i*i'"),
+            now=time.time())
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            j = sched.job_info(jid)
+            if j is not None and j.status.is_terminal:
+                break
+            time.sleep(0.05)
+        j = sched.job_info(jid)
+        assert j.status == JobStatus.COMPLETED
+        assert j.cpu_seconds > 0.0
+        assert j.max_rss_bytes > 20 << 20   # at least the bytearray
+        assert j.steps[0].cpu_seconds == j.cpu_seconds
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()

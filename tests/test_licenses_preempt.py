@@ -184,3 +184,49 @@ def test_preempt_evicts_fewest_lowest_youngest():
     assert hi in started
     assert sched.job_info(older).status == JobStatus.RUNNING
     assert sched.job_info(younger).status == JobStatus.PENDING
+
+
+def test_remote_license_sync(tmp_path):
+    """Remote licenses reconcile from a sync program (reference
+    server-synced LicenseManager, LicenseManager.h:46-125): totals and
+    external usage follow the server; this cluster's own seats and
+    local licenses never move."""
+    from cranesched_tpu.ctld.licenses import LicenseManager, LicenseSyncer
+
+    mgr = LicenseManager()
+    mgr.configure("matlab", 10, remote=True)
+    mgr.configure("ansys", 4)          # local: the server must not touch
+    assert mgr.malloc({"matlab": 3})   # our own seats
+
+    prog = tmp_path / "lmstat.sh"
+    prog.write_text("#!/bin/bash\n"
+                    "echo '# comment ignored'\n"
+                    "echo matlab 16 5\n"
+                    "echo ansys 99 99\n"
+                    "echo fluent 8 2\n"
+                    "echo garbage line_without_numbers x\n")
+    prog.chmod(0o755)
+    syncer = LicenseSyncer(mgr, str(prog), interval=3600)
+    assert syncer.sync_once()
+
+    m = mgr.licenses["matlab"]
+    assert (m.total, m.in_use, m.external_used) == (16, 3, 5)
+    assert m.free == 8
+    a = mgr.licenses["ansys"]          # local license shadows the name
+    assert (a.total, a.external_used) == (4, 0)
+    f = mgr.licenses["fluent"]         # discovered from the server
+    assert f.remote and (f.total, f.external_used) == (8, 2)
+    assert f.free == 6
+
+    # availability math includes external usage
+    assert not mgr.sufficient({"matlab": 9})
+    assert mgr.sufficient({"matlab": 8})
+
+    # a failing sync keeps the last observation
+    bad = tmp_path / "bad.sh"
+    bad.write_text("#!/bin/bash\nexit 3\n")
+    bad.chmod(0o755)
+    syncer2 = LicenseSyncer(mgr, str(bad))
+    assert not syncer2.sync_once()
+    assert syncer2.last_error
+    assert mgr.licenses["matlab"].total == 16
