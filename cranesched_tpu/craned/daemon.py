@@ -56,7 +56,7 @@ class _Alloc:
 
     def __init__(self, job_id: int, incarnation: int, gres_held,
                  env: dict, procs_path, implicit: bool,
-                 cores_held: tuple[int, ...] = ()):
+                 cores_held: tuple[int, ...] = (), user: str = ""):
         self.job_id = job_id
         self.incarnation = incarnation
         self.gres_held = gres_held or {}
@@ -66,6 +66,9 @@ class _Alloc:
         self.implicit = implicit
         # cpuset-pinned core ids (returned to the node pool on free)
         self.cores_held = tuple(cores_held)
+        # owning user (the ssh-access gate: PAM asks 'does USER have a
+        # live allocation here?', reference Pam.cpp:37-112)
+        self.user = user
 
 
 class _Step:
@@ -103,7 +106,8 @@ class CranedDaemon:
                  token: str = "",
                  prolog: str = "", epilog: str = "",
                  tls=None, tls_name: str = "ctld",
-                 container_runtime: str | None = None):
+                 container_runtime: str | None = None,
+                 pam_alias: bool = False):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -159,6 +163,8 @@ class CranedDaemon:
             container_runtime = (_shutil.which("podman")
                                  or _shutil.which("docker") or "")
         self.container_runtime = container_runtime
+        # publish /var/run/crane/pam.sock (real daemon deployments)
+        self.pam_alias = pam_alias
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = make_cgroups(cgroup_root)
@@ -474,7 +480,8 @@ class CranedDaemon:
                 for slot in slots
                 if (rule := self._device_rule(pair, slot)) is not None)
         alloc = _Alloc(job_id, request.incarnation, gres_held, env,
-                       None, implicit, cores_held=cores)
+                       None, implicit, cores_held=cores,
+                       user=spec.user)
         with self._lock:
             raced = self._allocs.get(job_id)
             if raced is not None and raced.incarnation == \
@@ -976,7 +983,7 @@ class CranedDaemon:
                              for pair, slots in a.gres_held.items()},
                        cores=list(a.cores_held),
                        procs=a.procs_path, env=a.env,
-                       implicit=a.implicit)
+                       implicit=a.implicit, user=a.user)
                   for a in self._allocs.values()]
         tmp = self._registry_path + ".tmp"
         try:
@@ -1007,7 +1014,8 @@ class CranedDaemon:
                  for k, v in (arow.get("gres") or {}).items()},
                 arow.get("env") or {}, arow.get("procs"),
                 bool(arow.get("implicit", True)),
-                cores_held=tuple(arow.get("cores") or ()))
+                cores_held=tuple(arow.get("cores") or ()),
+                user=arow.get("user", ""))
             with self._lock:
                 self._allocs[alloc.job_id] = alloc
                 # re-deduct from the pools (ignore already-missing
@@ -1058,6 +1066,126 @@ class CranedDaemon:
             threading.Thread(target=self._finish_step,
                              args=(step, report), daemon=True).start()
 
+    # ---- ssh-to-node gate (the CranedForPam surface) ----
+    #
+    # Reference: CranedForPamServer over a unix socket
+    # (Crane.proto:1671-1677) consumed by the PAM module
+    # (src/Misc/Pam/Pam.cpp:37-112 — account phase: allow ssh only if
+    # the user has a job here; session phase: migrate the sshd process
+    # into the job's cgroup).  The wire here is a newline protocol a
+    # dependency-free C client (native/pam_crane.c) can speak:
+    #
+    #   ACCESS <user>\n        -> OK <job_id> | DENY <reason>
+    #   ADOPT <user> <pid>\n   -> OK <job_id> (+ ENV K=V lines + END)
+
+    def _pam_find_alloc(self, user: str):
+        with self._lock:
+            allocs = [a for a in self._allocs.values()
+                      if a.user == user]
+        # newest allocation wins (the reference adopts into the most
+        # recent job when several qualify)
+        return max(allocs, key=lambda a: a.job_id, default=None)
+
+    def _pam_handle(self, line: str) -> str:
+        parts = line.split()
+        if len(parts) >= 2 and parts[0] == "ACCESS":
+            alloc = self._pam_find_alloc(parts[1])
+            if alloc is None:
+                return f"DENY no running job of {parts[1]} here\n"
+            return f"OK {alloc.job_id}\n"
+        if len(parts) >= 3 and parts[0] == "ADOPT":
+            alloc = self._pam_find_alloc(parts[1])
+            if alloc is None:
+                return f"DENY no running job of {parts[1]} here\n"
+            try:
+                pid = int(parts[2])
+            except ValueError:
+                return "DENY bad pid\n"
+            for pp in ([alloc.procs_path]
+                       if isinstance(alloc.procs_path, str)
+                       else alloc.procs_path or []):
+                try:
+                    with open(pp, "w") as fh:
+                        fh.write(str(pid))
+                except OSError:
+                    pass   # cgroup unavailable: access still granted,
+                           # containment best-effort (documented gap)
+            out = [f"OK {alloc.job_id}\n"]
+            for key, value in sorted(alloc.env.items()):
+                # the frame is newline-delimited: an env value carrying
+                # a newline (user-chosen job names reach CRANE_JOB_NAME)
+                # must not forge protocol lines
+                if any(c in key or c in str(value)
+                       for c in ("\n", "\r")):
+                    continue
+                out.append(f"ENV {key}={value}\n")
+            out.append("END\n")
+            return "".join(out)
+        return "DENY bad request\n"
+
+    def _pam_serve_conn(self, conn) -> None:
+        import socket as _socket
+        try:
+            conn.settimeout(5.0)
+            data = b""
+            while not data.endswith(b"\n") and len(data) < 4096:
+                chunk = conn.recv(256)
+                if not chunk:
+                    break
+                data += chunk
+            reply = self._pam_handle(
+                data.decode("utf-8", "replace").strip())
+            conn.sendall(reply.encode())
+        except (OSError, _socket.timeout):
+            pass
+        finally:
+            conn.close()
+
+    def _pam_loop(self, sock) -> None:
+        # thread per connection: one stalled client must not
+        # head-of-line-block every ssh login on the node
+        while not self._stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._pam_serve_conn,
+                             args=(conn,), daemon=True).start()
+
+    def _start_pam_socket(self) -> str | None:
+        import socket as _socket
+        path = os.path.join(self._steps_dir, "pam.sock")
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        try:
+            sock = _socket.socket(_socket.AF_UNIX,
+                                  _socket.SOCK_STREAM)
+            sock.bind(path)
+            # root-only: sshd's PAM stack runs as root; users must not
+            # probe who runs what through this side door
+            os.chmod(path, 0o600)
+            sock.listen(8)
+        except OSError:
+            return None
+        self._pam_sock = sock
+        threading.Thread(target=self._pam_loop, args=(sock,),
+                         daemon=True).start()
+        # best-effort well-known alias (the C client's DEFAULT_SOCKET):
+        # daemon deployments (craned_main) get a stable path without
+        # socket= config; embedded/test daemons never touch /var/run
+        if self.pam_alias:
+            alias = "/var/run/crane/pam.sock"
+            try:
+                os.makedirs(os.path.dirname(alias), exist_ok=True)
+                if os.path.islink(alias) or os.path.exists(alias):
+                    os.unlink(alias)
+                os.symlink(path, alias)
+            except OSError:
+                pass
+        return path
+
     # ---- lifecycle: serve + register + ping ----
 
     _RPCS = {
@@ -1103,6 +1231,7 @@ class CranedDaemon:
         # in the registry when the re-register reconcile runs, or the
         # expectations exchange would treat them as dead
         self._recover_steps()
+        self.pam_socket = self._start_pam_socket()
         threading.Thread(target=self._fsm_loop, daemon=True).start()
         if self.health_program:
             threading.Thread(target=self._health_loop,
@@ -1206,6 +1335,11 @@ class CranedDaemon:
         processes), so a new daemon on the same workdir can re-adopt
         them."""
         self._stop.set()
+        if getattr(self, "_pam_sock", None) is not None:
+            try:
+                self._pam_sock.close()
+            except OSError:
+                pass
         if not graceful:
             self._crashed = True
             self._ctld.close()   # closed first: no report can escape

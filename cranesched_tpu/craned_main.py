@@ -105,7 +105,8 @@ def main(argv=None) -> int:
                        key=args.tls_key)
              if args.tls_ca else None),
         tls_name=args.tls_name,
-        container_runtime=args.container_runtime)
+        container_runtime=args.container_runtime,
+        pam_alias=True)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)

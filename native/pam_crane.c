@@ -1,0 +1,156 @@
+/* ssh-to-node gate: the PAM client of the craned's CranedForPam
+ * surface.
+ *
+ * Reference: src/Misc/Pam/Pam.cpp:37-112 — pam_sm_acct_mgmt allows
+ * ssh only when the user has a job on this node (querying the local
+ * craned), pam_sm_open_session migrates the sshd process into the
+ * job's cgroup and imports the step environment.  The craned side
+ * here speaks a newline protocol over a root-only unix socket
+ * (cranesched_tpu/craned/daemon.py::_pam_handle):
+ *
+ *     ACCESS <user>\n       ->  OK <job_id> | DENY <reason>
+ *     ADOPT <user> <pid>\n  ->  OK <job_id> (+ ENV K=V... + END)
+ *
+ * Build modes:
+ *   - with libpam-dev (compile with -DHAVE_PAM -shared -fPIC
+ *     -lpam -o pam_crane.so): a real PAM module —
+ *         account  required  pam_crane.so socket=/path/pam.sock
+ *         session  optional  pam_crane.so socket=/path/pam.sock
+ *   - always (cc pam_crane.c -o crane_pam_helper): a pam_exec(8)
+ *     helper for hosts without PAM headers at build time —
+ *         account  required  pam_exec.so /usr/sbin/crane_pam_helper
+ *     It reads PAM_USER/PAM_TYPE from the environment (pam_exec
+ *     contract), exits 0 to allow, 1 to deny; on open_session it
+ *     adopts its PARENT pid (the sshd session process).
+ *
+ * Zero dependencies beyond libc by design: the craned deliberately
+ * serves this surface as a line protocol rather than gRPC so the PAM
+ * hot path stays a 50-line static client.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define DEFAULT_SOCKET "/var/run/crane/pam.sock"
+
+static int pam_query(const char *socket_path, const char *request,
+                     char *reply, size_t reply_len) {
+    struct sockaddr_un addr;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0) {
+        close(fd);
+        return -1;
+    }
+    /* MSG_NOSIGNAL: a peer reset between connect and send must fail
+     * closed, not SIGPIPE the application hosting the PAM stack */
+    if (send(fd, request, strlen(request), MSG_NOSIGNAL) < 0) {
+        close(fd);
+        return -1;
+    }
+    ssize_t off = 0, n;
+    while (off < (ssize_t)reply_len - 1 &&
+           (n = read(fd, reply + off, reply_len - 1 - off)) > 0)
+        off += n;
+    close(fd);
+    if (off <= 0) return -1;
+    reply[off] = '\0';
+    return 0;
+}
+
+/* returns 0 = allow, 1 = deny */
+static int do_access(const char *socket_path, const char *user) {
+    char req[256], rep[512];
+    snprintf(req, sizeof(req), "ACCESS %s\n", user);
+    if (pam_query(socket_path, req, rep, sizeof(rep)) != 0)
+        return 1; /* craned unreachable: fail closed */
+    return strncmp(rep, "OK", 2) == 0 ? 0 : 1;
+}
+
+/* reply buffer is caller-supplied so module mode can import the ENV
+ * lines into the PAM environment */
+static int do_adopt(const char *socket_path, const char *user,
+                    long pid, char *rep, size_t rep_len) {
+    char req[256];
+    snprintf(req, sizeof(req), "ADOPT %s %ld\n", user, pid);
+    if (pam_query(socket_path, req, rep, rep_len) != 0)
+        return 1;
+    return strncmp(rep, "OK", 2) == 0 ? 0 : 1;
+}
+
+#ifdef HAVE_PAM
+#include <security/pam_modules.h>
+
+static const char *module_socket(int argc, const char **argv) {
+    for (int i = 0; i < argc; i++)
+        if (strncmp(argv[i], "socket=", 7) == 0) return argv[i] + 7;
+    return DEFAULT_SOCKET;
+}
+
+int pam_sm_acct_mgmt(pam_handle_t *pamh, int flags, int argc,
+                     const char **argv) {
+    const char *user = NULL;
+    (void)flags;
+    if (pam_get_user(pamh, &user, NULL) != PAM_SUCCESS || !user)
+        return PAM_AUTH_ERR;
+    if (getuid() == 0 && strcmp(user, "root") == 0)
+        return PAM_SUCCESS; /* never lock out root */
+    return do_access(module_socket(argc, argv), user) == 0
+               ? PAM_SUCCESS
+               : PAM_AUTH_ERR;
+}
+
+int pam_sm_open_session(pam_handle_t *pamh, int flags, int argc,
+                        const char **argv) {
+    const char *user = NULL;
+    char rep[16384];
+    (void)flags;
+    if (pam_get_user(pamh, &user, NULL) != PAM_SUCCESS || !user)
+        return PAM_SESSION_ERR;
+    if (strcmp(user, "root") == 0) return PAM_SUCCESS;
+    /* adopt the PAM-invoking process (sshd's session child) and
+     * import the step environment into the session (the reference's
+     * SetStepEnv half, Pam.cpp:112+) */
+    if (do_adopt(module_socket(argc, argv), user, (long)getpid(),
+                 rep, sizeof(rep)) == 0) {
+        char *line = strtok(rep, "\n");
+        while (line) {
+            if (strncmp(line, "ENV ", 4) == 0)
+                pam_putenv(pamh, line + 4);
+            line = strtok(NULL, "\n");
+        }
+    }
+    return PAM_SUCCESS; /* adoption is best-effort, access was gated
+                           by the account phase */
+}
+
+int pam_sm_close_session(pam_handle_t *pamh, int flags, int argc,
+                         const char **argv) {
+    (void)pamh; (void)flags; (void)argc; (void)argv;
+    return PAM_SUCCESS;
+}
+#endif /* HAVE_PAM */
+
+#ifndef PAM_MODULE_ONLY
+/* pam_exec(8) helper mode: PAM_USER and PAM_TYPE arrive in the
+ * environment; argv[1] may override the socket path. */
+int main(int argc, char **argv) {
+    char rep[16384];
+    const char *socket_path = argc > 1 ? argv[1] : DEFAULT_SOCKET;
+    const char *user = getenv("PAM_USER");
+    const char *type = getenv("PAM_TYPE");
+    if (!user) return 1;
+    if (strcmp(user, "root") == 0) return 0;
+    if (type && strcmp(type, "open_session") == 0)
+        return do_adopt(socket_path, user, (long)getppid(), rep,
+                        sizeof(rep));
+    return do_access(socket_path, user);
+}
+#endif

@@ -1,0 +1,151 @@
+"""ssh-to-node gate: the CranedForPam surface + PAM client.
+
+Reference: src/Misc/Pam/Pam.cpp:37-112 (account phase gates ssh on
+having a job here; session phase migrates sshd into the job cgroup)
+and CranedForPamServer (Crane.proto:1671-1677).  The craned serves a
+root-only unix socket speaking a line protocol; native/pam_crane.c is
+the dependency-free C client (PAM module with libpam-dev, pam_exec
+helper otherwise — the helper binary is exercised here for real)."""
+
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+from cranesched_tpu.craned.cgroup import CgroupV1
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+PAM_SRC = os.path.join(os.path.dirname(__file__), os.pardir,
+                       "native", "pam_crane.c")
+
+
+@pytest.fixture(scope="session")
+def pam_helper(tmp_path_factory):
+    """Build the C helper fresh (a committed binary would be stale on
+    other machines; compile takes ~0.2 s)."""
+    import shutil
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler for the PAM helper")
+    out = str(tmp_path_factory.mktemp("pam") / "crane_pam_helper")
+    subprocess.run(["gcc", "-O2", "-o", out, PAM_SRC],
+                   check=True, timeout=120)
+    return out
+
+
+def _fake_v1_tree(root):
+    for c in CgroupV1.CONTROLLERS:
+        os.makedirs(os.path.join(root, c), exist_ok=True)
+    for ctl, val in (("cpuset.cpus", "0-3"), ("cpuset.mems", "0")):
+        with open(os.path.join(root, "cpuset", ctl), "w") as fh:
+            fh.write(val)
+    return root
+
+
+def _ask(sock_path: str, request: str) -> str:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sock_path)
+    s.sendall(request.encode())
+    data = b""
+    while chunk := s.recv(4096):
+        data += chunk
+    s.close()
+    return data.decode()
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    cgroot = _fake_v1_tree(str(tmp_path / "cg"))
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("pg0", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5, cgroup_root=cgroot)
+    d.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and d.state != CranedState.READY:
+        time.sleep(0.05)
+    assert d.state == CranedState.READY
+    assert d.pam_socket, "pam socket did not come up"
+    yield sched, d, cgroot
+    d.stop()
+    dispatcher.close()
+    server.stop()
+
+
+def _run_job(sched, user="alice"):
+    jid = sched.submit(JobSpec(
+        user=user, res=ResourceSpec(cpu=1.0),
+        script="sleep 60", time_limit=120), now=time.time())
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if jid in sched.running and \
+                sched.running[jid].status == JobStatus.RUNNING and \
+                sched.running[jid].steps:
+            return jid
+        time.sleep(0.05)
+    raise AssertionError("job never started")
+
+
+def test_access_gated_by_job_ownership(plane):
+    sched, d, _ = plane
+    jid = _run_job(sched, "alice")
+    time.sleep(0.3)  # allocation lands just before RUNNING is visible
+    assert _ask(d.pam_socket, "ACCESS alice\n") == f"OK {jid}\n"
+    assert _ask(d.pam_socket, "ACCESS mallory\n").startswith("DENY")
+    assert _ask(d.pam_socket, "garbage\n").startswith("DENY")
+
+
+def test_adopt_moves_pid_into_job_cgroup(plane):
+    sched, d, cgroot = plane
+    jid = _run_job(sched, "alice")
+    time.sleep(0.3)
+    probe = subprocess.Popen(["sleep", "30"])
+    try:
+        reply = _ask(d.pam_socket, f"ADOPT alice {probe.pid}\n")
+        lines = reply.splitlines()
+        assert lines[0] == f"OK {jid}"
+        assert lines[-1] == "END"
+        env = dict(line[4:].split("=", 1) for line in lines[1:-1]
+                   if line.startswith("ENV "))
+        assert env.get("CRANE_JOB_NAME") is not None
+        # the pid landed in the job's cgroup (fake v1 tree records it)
+        procs = os.path.join(cgroot, "cpu", "crane", f"job_{jid}",
+                             "cgroup.procs")
+        assert open(procs).read().strip() == str(probe.pid)
+    finally:
+        probe.kill()
+
+
+def test_pam_exec_helper_binary(plane, pam_helper):
+    """The C client end to end, exactly as pam_exec invokes it."""
+    sched, d, _ = plane
+    _run_job(sched, "alice")
+    time.sleep(0.3)
+
+    def helper(user, ptype="account"):
+        return subprocess.run(
+            [pam_helper, d.pam_socket],
+            env={"PAM_USER": user, "PAM_TYPE": ptype},
+            timeout=10).returncode
+
+    assert helper("alice") == 0
+    assert helper("mallory") == 1
+    assert helper("root") == 0           # never locked out
+    assert helper("alice", "open_session") == 0
