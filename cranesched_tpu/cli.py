@@ -381,6 +381,9 @@ def _run_step_in_alloc(args, client, cfored) -> int:
                        interactive_token=cfored.secret,
                        pty=args.pty,
                        overlap=getattr(args, "overlap", False))
+    if getattr(args, "x11", False):
+        spec.x11 = True
+        spec.x11_cookie = _x11_cookie()
     if getattr(args, "follow_step", None) is not None:
         spec.follow_step = args.follow_step
     if getattr(args, "image", ""):
@@ -454,6 +457,23 @@ def cmd_cattach(args) -> int:
         client.close()
 
 
+def _x11_cookie() -> str:
+    """The user's magic cookie for $DISPLAY (best effort — an open X
+    server needs none)."""
+    import shutil
+    import subprocess as _sp
+    display = os.environ.get("DISPLAY", "")
+    if not display or shutil.which("xauth") is None:
+        return ""
+    try:
+        out = _sp.run(["xauth", "list", display], capture_output=True,
+                      text=True, timeout=10)
+        line = out.stdout.strip().splitlines()
+        return line[0] if line else ""
+    except (OSError, _sp.SubprocessError):
+        return ""
+
+
 def cmd_crun(args) -> int:
     """Interactive run with REAL bidi streaming: the client hosts an
     embedded CraneFored service; the supervisor connects back and
@@ -487,6 +507,9 @@ def cmd_crun(args) -> int:
         spec.interactive_address = cfored.address
         spec.interactive_token = cfored.secret
         spec.pty = args.pty
+        if args.x11:
+            spec.x11 = True
+            spec.x11_cookie = _x11_cookie()
         reply = client.submit(spec)
         if not reply.job_id:
             print(f"crun: submit failed: {reply.error}",
@@ -854,6 +877,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap", action="store_true",
                    help="hold no share of the allocation "
                         "(observation steps)")
+    p.add_argument("--x11", action="store_true",
+                   help="forward X11: the step gets a DISPLAY relayed "
+                        "to this client's X server")
     p.set_defaults(func=cmd_crun)
 
     p = sub.add_parser("ccon", help="container jobs (ccon run IMAGE "

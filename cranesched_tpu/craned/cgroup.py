@@ -47,6 +47,19 @@ DEFAULT_DEVICE_RULES = (
 )
 
 
+def write_pid_to_cgroup(procs_path, pid: int) -> None:
+    """Attach ``pid`` to a job's cgroup(s): one cgroup.procs path for
+    v2, a list (one per controller hierarchy) for v1.  Best-effort by
+    contract — callers run where cgroups may be absent entirely."""
+    for pp in ([procs_path] if isinstance(procs_path, str)
+               else procs_path or []):
+        try:
+            with open(pp, "w") as fh:
+                fh.write(str(pid))
+        except OSError:
+            pass
+
+
 def _kill_pids(procs_file: str) -> bool:
     """SIGKILL everything listed in a cgroup.procs file; True if the
     file was readable (regardless of whether anything lived)."""

@@ -31,7 +31,10 @@ from concurrent import futures
 
 import grpc
 
-from cranesched_tpu.craned.cgroup import make_cgroups
+from cranesched_tpu.craned.cgroup import (
+    make_cgroups,
+    write_pid_to_cgroup,
+)
 from cranesched_tpu.ops.resources import gres_key_pair, gres_key_str
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.client import CtldClient
@@ -653,7 +656,12 @@ class CranedDaemon:
                 step_spec.res if step_spec and step_spec.HasField("res")
                 else spec.res) if image else None,
             rendezvous_serve=rdzv_serve_port,
-            rendezvous_token=request.rendezvous_token or "")
+            rendezvous_token=request.rendezvous_token or "",
+            x11=bool(step_spec.x11 if step_spec and step_spec.x11
+                     else spec.x11),
+            x11_cookie=(step_spec.x11_cookie
+                        if step_spec and step_spec.x11_cookie
+                        else spec.x11_cookie) or "")
         try:
             proc.stdin.write((json.dumps(init) + "\n").encode())
             proc.stdin.flush()
@@ -1101,15 +1109,9 @@ class CranedDaemon:
                 pid = int(parts[2])
             except ValueError:
                 return "DENY bad pid\n"
-            for pp in ([alloc.procs_path]
-                       if isinstance(alloc.procs_path, str)
-                       else alloc.procs_path or []):
-                try:
-                    with open(pp, "w") as fh:
-                        fh.write(str(pid))
-                except OSError:
-                    pass   # cgroup unavailable: access still granted,
-                           # containment best-effort (documented gap)
+            # cgroup unavailable = access still granted, containment
+            # best-effort (documented gap)
+            write_pid_to_cgroup(alloc.procs_path, pid)
             out = [f"OK {alloc.job_id}\n"]
             for key, value in sorted(alloc.env.items()):
                 # the frame is newline-delimited: an env value carrying
@@ -1148,7 +1150,14 @@ class CranedDaemon:
             try:
                 conn, _ = sock.accept()
             except OSError:
-                return
+                # transient accept failures (EMFILE bursts) must not
+                # kill the gate — the fail-closed client would then
+                # deny every ssh until craned restarts.  Only a closed
+                # socket (shutdown) ends the loop.
+                if self._stop.is_set() or sock.fileno() < 0:
+                    return
+                time.sleep(0.2)
+                continue
             threading.Thread(target=self._pam_serve_conn,
                              args=(conn,), daemon=True).start()
 

@@ -209,6 +209,154 @@ class _InteractiveIO:
                 time.sleep(0.05)
 
 
+class _X11Forwarder:
+    """X11 forwarding for interactive steps (reference
+    SetupX11forwarding_, CforedClient.h:29-66): a DISPLAY listener on
+    the compute node; every accepted X connection becomes its own
+    StepIO stream (first chunk stream="x11") that the client-side hub
+    relays to the user's real X display.  The cookie (xauth list
+    output) is installed into a job-private XAUTHORITY so job-side
+    clients authenticate against the relayed display."""
+
+    def __init__(self, address: str, job_id: int, step_id: int,
+                 token: str, tls_ca: str = ""):
+        import socket as _socket
+        self.address = address
+        self.job_id = job_id
+        self.step_id = step_id
+        self.token = token
+        self.tls_ca = tls_ca
+        # probe conventional display ports (X display N <=> TCP
+        # 6000+N) like real X servers do — deriving N from an
+        # arbitrary ephemeral port can go negative on hosts with a
+        # lowered ip_local_port_range
+        self._sock = None
+        for n in range(20, 220):
+            s = _socket.socket()
+            try:
+                s.bind(("127.0.0.1", 6000 + n))
+            except OSError:
+                s.close()
+                continue
+            s.listen(16)
+            self._sock = s
+            self.port = 6000 + n
+            self.display = f"127.0.0.1:{n}"
+            break
+        if self._sock is None:
+            raise OSError("no free X display port in 6020-6219")
+        self._conn_id = 0
+        self._channel = None
+
+    def start(self) -> None:
+        threading.Thread(target=self._accept_loop,
+                         daemon=True).start()
+
+    def _grpc_channel(self):
+        import grpc
+        if self._channel is None:
+            if self.tls_ca:
+                from cranesched_tpu.utils.pki import (TlsConfig,
+                                                      secure_channel)
+                self._channel = secure_channel(
+                    self.address, TlsConfig(ca=self.tls_ca))
+            else:
+                self._channel = grpc.insecure_channel(self.address)
+        return self._channel
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conn_id += 1
+            threading.Thread(target=self._relay,
+                             args=(conn, self._conn_id),
+                             daemon=True).start()
+
+    def _relay(self, conn, conn_id: int) -> None:
+        import queue as _queue
+
+        import grpc
+        from cranesched_tpu.rpc import crane_pb2 as pb
+        from cranesched_tpu.rpc.consts import CFORED_SERVICE
+
+        sendq: _queue.Queue = _queue.Queue()
+        sendq.put(pb.StepIOChunk(job_id=self.job_id,
+                                 step_id=self.step_id,
+                                 token=self.token, stream="x11",
+                                 x11_conn=conn_id))
+
+        def requests():
+            while True:
+                item = sendq.get()
+                if item is None:
+                    return
+                yield item
+
+        def pump_to_hub():
+            try:
+                while data := conn.recv(65536):
+                    sendq.put(pb.StepIOChunk(data=data))
+            except OSError:
+                pass
+            finally:
+                sendq.put(None)
+
+        stub = self._grpc_channel().stream_stream(
+            f"/{CFORED_SERVICE}/StepIO",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.StepIOChunk.FromString)
+        call = stub(requests())
+        threading.Thread(target=pump_to_hub, daemon=True).start()
+        try:
+            for chunk in call:
+                if chunk.data:
+                    conn.sendall(chunk.data)
+                if chunk.exited:
+                    break
+        except (grpc.RpcError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def install_cookie(self, cookie: str, env: dict,
+                       workdir: str) -> None:
+        """xauth add the user's cookie for the relayed display into a
+        job-private authority file (best-effort: no xauth binary =
+        cookie-less display, servers in open mode still work)."""
+        if not cookie:
+            return
+        import shutil
+        if shutil.which("xauth") is None:
+            return
+        xauth_file = os.path.join(
+            workdir, f".crane_xauth_{self.job_id}_{self.step_id}")
+        env["XAUTHORITY"] = xauth_file
+        parts = cookie.split()
+        # accept both "proto hexkey" and full "display proto hexkey"
+        proto, hexkey = (parts[-2], parts[-1]) if len(parts) >= 2 \
+            else ("MIT-MAGIC-COOKIE-1", parts[0])
+        try:
+            subprocess.run(
+                ["xauth", "-f", xauth_file, "add", self.display,
+                 proto, hexkey],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=15, check=False)
+        except (OSError, subprocess.SubprocessError):
+            pass   # cookie install is best-effort by contract
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def _child_argv(script: str, env: dict, container: dict | None,
                 interactive: bool = False, pty: bool = False) -> list:
     """argv of the step's child: plain ``bash -c`` for process steps,
@@ -345,6 +493,22 @@ def main() -> int:
             print(f"rendezvous bind failed: {exc}", file=sys.stderr)
             rdzv = None
 
+    x11 = None
+    if init.get("x11") and init.get("cfored"):
+        try:
+            x11 = _X11Forwarder(init["cfored"], job_id,
+                                int(init.get("step_id") or 0),
+                                token=init.get("cfored_token") or "",
+                                tls_ca=init.get("tls_ca") or "")
+            x11.install_cookie(init.get("x11_cookie") or "", env,
+                               os.getcwd())
+            env["DISPLAY"] = x11.display
+            x11.start()
+        except OSError as exc:
+            print(f"x11 forwarding unavailable: {exc}",
+                  file=sys.stderr)
+            x11 = None
+
     container = init.get("container")
     argv = _child_argv(script, env, container,
                        interactive=interactive is not None,
@@ -356,17 +520,11 @@ def main() -> int:
         child = subprocess.Popen(
             argv, stdout=out, stderr=out, env=env,
             start_new_session=True)
-    # optional cgroup attachment (the craned pre-created the cgroup and
-    # passed its cgroup.procs path — one for v2, one per controller
-    # hierarchy for v1)
-    procs_path = init.get("cgroup_procs")
-    for pp in ([procs_path] if isinstance(procs_path, str)
-               else procs_path or []):
-        try:
-            with open(pp, "w") as fh:
-                fh.write(str(child.pid))
-        except OSError:
-            pass  # cgroupfs unavailable: resource limits best-effort
+    # optional cgroup attachment (the craned pre-created the cgroup
+    # and passed its cgroup.procs path(s); best-effort when cgroupfs
+    # is unavailable)
+    from cranesched_tpu.craned.cgroup import write_pid_to_cgroup
+    write_pid_to_cgroup(init.get("cgroup_procs"), child.pid)
 
     state = {"suspended_at": None, "suspended_total": 0.0,
              "terminated": False, "time_limit": time_limit}

@@ -168,6 +168,9 @@ class StepSpec:
     # overlap placement: run on the nodes of this RUNNING step (the
     # cattach target); None = allocation prefix
     follow_step: int | None = None
+    # X11 forwarding (crun --x11 inside an allocation)
+    x11: bool = False
+    x11_cookie: str = ""
     # simulation-only (real planes learn these from the supervisor)
     sim_runtime: float | None = None
     sim_exit_code: int = 0
@@ -254,6 +257,10 @@ class JobSpec:
     # ccon run).  Mounts are host:ctr[:ro] specs passed to the runtime.
     container_image: str = ""
     container_mounts: Sequence[str] = ()
+    # X11 forwarding for the interactive step (reference
+    # SetupX11forwarding_, CforedClient.h:29-66)
+    x11: bool = False
+    x11_cookie: str = ""
     # simulation-only: how long the job actually runs and its exit code
     # (real clusters learn these when the step exits)
     sim_runtime: float | None = None

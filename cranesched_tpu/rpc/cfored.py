@@ -113,10 +113,14 @@ class CforedServer:
     forge the exit status).  Empty = open hub (tests, trusted loopback).
     """
 
-    def __init__(self, secret: str | None = None, tls=None):
+    def __init__(self, secret: str | None = None, tls=None,
+                 x_display: str | None = None):
         import secrets as _secrets
         self.secret = (_secrets.token_urlsafe(16) if secret is None
                        else secret)
+        # where X11 relay streams land (reference SetupX11forwarding_
+        # counterpart): the USER'S display — $DISPLAY by default
+        self.x_display = x_display
         # utils.pki.TlsConfig: the hub serves TLS and supervisors dial
         # back with the cluster CA (their side rides the craned's
         # config) — the stream secret stops being sniffable in flight
@@ -147,6 +151,11 @@ class CforedServer:
         if self.secret and first.token != self.secret:
             context.abort(_grpc.StatusCode.PERMISSION_DENIED,
                           "bad stream token")
+        if first.stream == "x11":
+            # a whole-stream X11 relay channel (one per X connection
+            # the job opened against the forwarded DISPLAY)
+            yield from self._x11_stream(request_iterator, context)
+            return
         sess = self._session(first.job_id, first.step_id)
         sess._push_output(first)
 
@@ -166,6 +175,61 @@ class CforedServer:
 
         threading.Thread(target=drain, daemon=True).start()
         yield from sess._stdin_iter()
+
+    def _connect_x_display(self):
+        """Socket to the user's X server from $DISPLAY grammar:
+        ':N[.s]' / 'unix:N' -> /tmp/.X11-unix/XN; 'host:N' ->
+        TCP host:6000+N."""
+        import os
+        import socket as _socket
+        display = self.x_display or os.environ.get("DISPLAY", "")
+        if not display:
+            raise OSError("no DISPLAY to relay X11 to")
+        host, _, num = display.rpartition(":")
+        number = int(num.split(".")[0] or 0)
+        if host in ("", "unix"):
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.connect(f"/tmp/.X11-unix/X{number}")
+            return s
+        return _socket.create_connection((host, 6000 + number),
+                                         timeout=10)
+
+    def _x11_stream(self, request_iterator, context):
+        """Relay one X connection: incoming chunks -> X server; X
+        server bytes -> response chunks.  Ends when either side
+        closes."""
+        try:
+            xsock = self._connect_x_display()
+        except OSError as exc:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"X display unavailable: {exc}")
+            return
+
+        def pump_to_x():
+            try:
+                for chunk in request_iterator:
+                    if chunk.data:
+                        xsock.sendall(chunk.data)
+            except (grpc.RpcError, OSError):
+                pass
+            finally:
+                try:
+                    xsock.shutdown(2)
+                except OSError:
+                    pass
+
+        threading.Thread(target=pump_to_x, daemon=True).start()
+        try:
+            while data := xsock.recv(65536):
+                yield pb.StepIOChunk(data=data)
+        except OSError:
+            pass
+        finally:
+            try:
+                xsock.close()
+            except OSError:
+                pass
+        yield pb.StepIOChunk(exited=True)
 
     def start(self, address: str | None = None,
               host_for_clients: str = "127.0.0.1") -> str:

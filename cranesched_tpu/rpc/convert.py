@@ -85,6 +85,8 @@ def spec_from_pb(msg) -> JobSpec:
         interactive_token=msg.interactive_token,
         container_image=msg.container_image,
         container_mounts=tuple(msg.container_mounts),
+        x11=msg.x11,
+        x11_cookie=msg.x11_cookie,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -115,6 +117,8 @@ def spec_to_pb(spec: JobSpec) -> pb.JobSpec:
         interactive_token=spec.interactive_token,
         container_image=spec.container_image,
         container_mounts=list(spec.container_mounts),
+        x11=spec.x11,
+        x11_cookie=spec.x11_cookie,
         sim_runtime=spec.sim_runtime or 0.0,
         sim_exit_code=spec.sim_exit_code)
     if spec.task_res is not None:
@@ -146,6 +150,8 @@ def step_spec_from_pb(msg) -> StepSpec:
         overlap=msg.overlap,
         follow_step=(msg.follow_step
                      if msg.HasField("follow_step") else None),
+        x11=msg.x11,
+        x11_cookie=msg.x11_cookie,
         sim_runtime=msg.sim_runtime or None,
         sim_exit_code=msg.sim_exit_code,
     )
@@ -162,6 +168,8 @@ def step_spec_to_pb(spec: StepSpec) -> pb.StepSpec:
                       container_image=spec.container_image,
                       container_mounts=list(spec.container_mounts),
                       overlap=spec.overlap,
+                      x11=spec.x11,
+                      x11_cookie=spec.x11_cookie,
                       sim_runtime=spec.sim_runtime or 0.0,
                       sim_exit_code=spec.sim_exit_code)
     if spec.follow_step is not None:

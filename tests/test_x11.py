@@ -1,0 +1,128 @@
+"""X11 forwarding for interactive steps.
+
+Reference: CforedClient.h:29-66 / SetupX11forwarding_ — the
+supervisor opens a DISPLAY listener on the compute node and relays X
+connections through the cfored stream to the user's X server.  The
+"X server" here is a fake TCP listener that acks bytes: the test
+proves the full relay path (job-side connect to $DISPLAY ->
+supervisor listener -> StepIO x11 stream -> hub -> user-side X
+socket and back)."""
+
+import socket
+import threading
+import time
+
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.rpc import serve
+from cranesched_tpu.rpc.cfored import CforedServer
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+
+class FakeXServer:
+    """Accepts 'X connections' and acks everything it receives."""
+
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self.received = []
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while data := conn.recv(65536):
+                self.received.append(data)
+                conn.sendall(b"xserver-ack:" + data)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._sock.close()
+
+
+def test_x11_relay_end_to_end(tmp_path):
+    xserver = FakeXServer()
+    # the hub treats the fake server as the user's display; its
+    # "display number" is port-6000 so the standard grammar resolves
+    hub = CforedServer(
+        x_display=f"127.0.0.1:{xserver.port - 6000}")
+    hub.start()
+
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, craned_timeout=30.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("x0", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5,
+                     cgroup_root=str(tmp_path / "nocg"))
+    d.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and d.state != CranedState.READY:
+            time.sleep(0.05)
+        script = (
+            "python3 - <<'PY'\n"
+            "import os, socket\n"
+            "host, num = os.environ['DISPLAY'].split(':')\n"
+            "s = socket.create_connection((host, 6000 + int(num)),"
+            " timeout=15)\n"
+            "s.sendall(b'x11-hello')\n"
+            "print('REPLY:' + s.recv(200).decode())\n"
+            "PY\n")
+        jid = sched.submit(JobSpec(
+            res=ResourceSpec(cpu=1.0), script=script, x11=True,
+            interactive_address=hub.address,
+            interactive_token=hub.secret,
+            time_limit=60), now=time.time())
+        sess = hub.expect(jid, 0)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for _, data in sess.read(timeout=25.0):
+                got.append(data)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        assert done.wait(timeout=25.0)
+        text = b"".join(got).decode()
+        assert "REPLY:xserver-ack:x11-hello" in text, text
+        assert sess.exit_code == 0
+        assert b"x11-hello" in b"".join(xserver.received)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            j = sched.job_info(jid)
+            if j is not None and j.status.is_terminal:
+                break
+            time.sleep(0.05)
+        assert sched.job_info(jid).status == JobStatus.COMPLETED
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()
+        hub.stop()
+        xserver.close()
