@@ -55,8 +55,17 @@ def _client():
         raise RuntimeError(
             "no CRANE_RENDEZVOUS in the environment — not inside a "
             "multi-node crane step?")
+    tls = None
+    ca = os.environ.get("CRANE_RENDEZVOUS_CA", "")
+    if ca:
+        # TLS cluster: rank-0 serves the fence/modex with its node
+        # cert; verify against the cluster CA so the gang token and
+        # modex payloads never ride plaintext node-to-node
+        from cranesched_tpu.utils.pki import TlsConfig
+        tls = TlsConfig(ca=ca)
     return RendezvousClient(
-        address, token=os.environ.get("CRANE_RENDEZVOUS_TOKEN", ""))
+        address, token=os.environ.get("CRANE_RENDEZVOUS_TOKEN", ""),
+        tls=tls)
 
 
 def fence(name: str, data: bytes = b"",

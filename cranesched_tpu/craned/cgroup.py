@@ -102,13 +102,32 @@ def _remove_dir(d: str, procs_file: str | None = None,
             os.rmdir(d)
             return True
         except OSError:
-            # fake cgroupfs trees (tests) hold regular files that, on
-            # a real kernel, vanish with the directory; drop them and
-            # retry at once so the common case pays no sleep (kernel
-            # controller files refuse unlink — ignored)
+            # container runtimes create CHILD cgroups under the job
+            # cgroup (--cgroup-parent=crane/job_<id>), so teardown
+            # must kill-then-rmdir bottom-up or destroy() exhausts its
+            # retries and leaks the job cgroup whenever it races
+            # container removal.  Fake cgroupfs trees (tests) also
+            # hold regular files that, on a real kernel, vanish with
+            # the directory; drop them and retry at once so the
+            # common case pays no sleep (kernel controller files
+            # refuse unlink — ignored)
             for name in os.listdir(d) if os.path.isdir(d) else ():
+                path = os.path.join(d, name)
+                if os.path.isdir(path):
+                    child_procs = os.path.join(path, "cgroup.procs")
+                    child_kill = os.path.join(path, "cgroup.kill")
+                    _remove_dir(
+                        path,
+                        procs_file=(child_procs
+                                    if os.path.exists(child_procs)
+                                    else None),
+                        kill_file=(child_kill
+                                   if os.path.exists(child_kill)
+                                   else None),
+                        retries=2, interval=interval)
+                    continue
                 try:
-                    os.unlink(os.path.join(d, name))
+                    os.unlink(path)
                 except OSError:
                     pass
             try:

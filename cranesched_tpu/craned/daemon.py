@@ -590,6 +590,13 @@ class CranedDaemon:
                 if request.rendezvous_token:
                     step_env["CRANE_RENDEZVOUS_TOKEN"] = \
                         request.rendezvous_token
+                if self.tls is not None:
+                    # TLS cluster: rank-0 serves the fence/modex with
+                    # its node cert, members verify with the cluster
+                    # CA (config consistency across craneds is a
+                    # cluster invariant, as with the reference's
+                    # config CRC check)
+                    step_env["CRANE_RENDEZVOUS_CA"] = self.tls.ca
                 # the rank-0 supervisor HOSTS the gang's fence/modex
                 # service at the advertised port (the PMIx-server
                 # role, Pmix.h:44)
@@ -614,13 +621,19 @@ class CranedDaemon:
         cfored = ((step_spec.interactive_address
                    if step_spec and step_spec.interactive_address
                    else spec.interactive_address) or "")
-        # "tls://host:port" convention: the hub serves TLS, so the
-        # supervisor must dial back with the cluster CA (which rides
-        # this craned's --tls-ca; a TLS hub against a CA-less craned
-        # fails the handshake — loudly, not silently downgraded)
+        # "tls://[identity@]host:port" convention: the hub serves TLS,
+        # so the supervisor must dial back with the cluster CA (which
+        # rides this craned's --tls-ca; a TLS hub against a CA-less
+        # craned fails the handshake — loudly, not silently
+        # downgraded).  The optional identity@ prefix carries the
+        # hub cert's issued name so the dial-back pins it (rejecting
+        # other cluster certs that would validate via loopback SANs).
         cfored_tls = cfored.startswith("tls://")
+        cfored_authority = ""
         if cfored_tls:
             cfored = cfored[len("tls://"):]
+            if "@" in cfored:
+                cfored_authority, cfored = cfored.split("@", 1)
         cfored_token = ((step_spec.interactive_token
                          if step_spec and step_spec.interactive_token
                          else spec.interactive_token) or "")
@@ -651,6 +664,16 @@ class CranedDaemon:
             control_path=control_path, report_path=report_path,
             tls_ca=(self.tls.ca
                     if cfored_tls and self.tls is not None else ""),
+            tls_authority=cfored_authority,
+            # rank-0's rendezvous service serves with this node's
+            # cluster cert when the cluster runs TLS: the per-gang
+            # bearer token and modex payloads never ride plaintext
+            # node-to-node (members dial with CRANE_RENDEZVOUS_CA)
+            rendezvous_tls=(
+                {"ca": self.tls.ca, "cert": self.tls.cert,
+                 "key": self.tls.key}
+                if rdzv_serve_port and self.tls is not None
+                and self.tls.cert else None),
             container=self._container_doc(
                 job_id, step_id, image, mounts, alloc,
                 step_spec.res if step_spec and step_spec.HasField("res")

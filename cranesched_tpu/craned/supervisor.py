@@ -57,15 +57,20 @@ class _InteractiveIO:
     every output byte before the exit status (CforedClient.h:60-63)."""
 
     def __init__(self, address: str, job_id: int, step_id: int,
-                 use_pty: bool, token: str = "", tls_ca: str = ""):
+                 use_pty: bool, token: str = "", tls_ca: str = "",
+                 tls_authority: str = ""):
         self.address = address
         self.job_id = job_id
         self.step_id = step_id
         self.use_pty = use_pty
         self.token = token
         # cluster CA path: when set, the dial-back to the cfored hub is
-        # TLS-verified (the stream token never travels plaintext)
+        # TLS-verified (the stream token never travels plaintext).
+        # tls_authority pins the hub cert's issued name — without it,
+        # ANY cluster-issued cert validates as the hub on loopback
+        # hosts (every cert carries localhost SANs)
         self.tls_ca = tls_ca
+        self.tls_authority = tls_authority
         self._q: queue.Queue = queue.Queue()
         self._readers: list[threading.Thread] = []
         self._call = None
@@ -124,8 +129,10 @@ class _InteractiveIO:
         if self.tls_ca:
             from cranesched_tpu.utils.pki import (TlsConfig,
                                                   secure_channel)
-            channel = secure_channel(self.address,
-                                     TlsConfig(ca=self.tls_ca))
+            tls = TlsConfig(ca=self.tls_ca)
+            if self.tls_authority:
+                tls = tls.pinned(self.tls_authority)
+            channel = secure_channel(self.address, tls)
         else:
             channel = grpc.insecure_channel(self.address)
 
@@ -219,13 +226,14 @@ class _X11Forwarder:
     clients authenticate against the relayed display."""
 
     def __init__(self, address: str, job_id: int, step_id: int,
-                 token: str, tls_ca: str = ""):
+                 token: str, tls_ca: str = "", tls_authority: str = ""):
         import socket as _socket
         self.address = address
         self.job_id = job_id
         self.step_id = step_id
         self.token = token
         self.tls_ca = tls_ca
+        self.tls_authority = tls_authority
         # probe conventional display ports (X display N <=> TCP
         # 6000+N) like real X servers do — deriving N from an
         # arbitrary ephemeral port can go negative on hosts with a
@@ -258,8 +266,10 @@ class _X11Forwarder:
             if self.tls_ca:
                 from cranesched_tpu.utils.pki import (TlsConfig,
                                                       secure_channel)
-                self._channel = secure_channel(
-                    self.address, TlsConfig(ca=self.tls_ca))
+                tls = TlsConfig(ca=self.tls_ca)
+                if self.tls_authority:
+                    tls = tls.pinned(self.tls_authority)
+                self._channel = secure_channel(self.address, tls)
             else:
                 self._channel = grpc.insecure_channel(self.address)
         return self._channel
@@ -431,11 +441,13 @@ def main() -> int:
 
     interactive = None
     if init.get("cfored"):
-        interactive = _InteractiveIO(init["cfored"], job_id,
-                                     int(init.get("step_id") or 0),
-                                     bool(init.get("pty")),
-                                     token=init.get("cfored_token") or "",
-                                     tls_ca=init.get("tls_ca") or "")
+        interactive = _InteractiveIO(
+            init["cfored"], job_id,
+            int(init.get("step_id") or 0),
+            bool(init.get("pty")),
+            token=init.get("cfored_token") or "",
+            tls_ca=init.get("tls_ca") or "",
+            tls_authority=init.get("tls_authority") or "")
 
     print("READY", flush=True)
     go = sys.stdin.readline().strip()
@@ -484,9 +496,14 @@ def main() -> int:
     rdzv = None
     if init.get("rendezvous_serve"):
         from cranesched_tpu.rpc.rendezvous import RendezvousServer
+        rdzv_tls = None
+        if init.get("rendezvous_tls"):
+            from cranesched_tpu.utils.pki import TlsConfig
+            rdzv_tls = TlsConfig(**init["rendezvous_tls"])
         rdzv = RendezvousServer(
             token=init.get("rendezvous_token") or "",
-            nranks=int(env.get("CRANE_NNODES") or 1))
+            nranks=int(env.get("CRANE_NNODES") or 1),
+            tls=rdzv_tls)
         try:
             rdzv.start(f"0.0.0.0:{init['rendezvous_serve']}")
         except Exception as exc:
@@ -496,10 +513,12 @@ def main() -> int:
     x11 = None
     if init.get("x11") and init.get("cfored"):
         try:
-            x11 = _X11Forwarder(init["cfored"], job_id,
-                                int(init.get("step_id") or 0),
-                                token=init.get("cfored_token") or "",
-                                tls_ca=init.get("tls_ca") or "")
+            x11 = _X11Forwarder(
+                init["cfored"], job_id,
+                int(init.get("step_id") or 0),
+                token=init.get("cfored_token") or "",
+                tls_ca=init.get("tls_ca") or "",
+                tls_authority=init.get("tls_authority") or "")
             x11.install_cookie(init.get("x11_cookie") or "", env,
                                os.getcwd())
             env["DISPLAY"] = x11.display

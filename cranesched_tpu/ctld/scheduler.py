@@ -1343,7 +1343,31 @@ class JobScheduler:
         """One cycle: drain status changes, snapshot, device solve, commit,
         dispatch.  Returns the job_ids started this cycle.  Per-phase
         wall-clock timings land in ``stats['last_cycle']`` (reference
-        phase trace, JobScheduler.cpp:1444-1447)."""
+        phase trace, JobScheduler.cpp:1444-1447).
+
+        This driver runs every phase inline (single-threaded callers,
+        tick mode, tests).  Concurrent servers use ``cycle_phases``
+        directly and drop their lock around each yielded solve closure
+        — see CtldServer._cycle_loop."""
+        gen = self.cycle_phases(now)
+        try:
+            fn = next(gen)
+            while True:
+                fn = gen.send(fn())
+        except StopIteration as stop:
+            return stop.value or []
+
+    def cycle_phases(self, now: float):
+        """The cycle as a generator: code between yields mutates
+        scheduler state and MUST run under the caller's lock; each
+        yielded closure is pure compute over snapshot arrays (the
+        device/native solve — the expensive 99%) and is safe to run
+        with the lock released.  Mid-solve mutations are caught at
+        commit: the meta event window (start_logging →
+        ResReduceEvents, the reference's NodeSelect revalidation
+        pattern, JobScheduler.cpp:1437-1540) flags touched nodes, and
+        _commit re-checks pending membership, licenses, QoS and the
+        authoritative ledger per job."""
         import time as _time
         t0 = _time.perf_counter()
         self.process_status_changes()
@@ -1373,6 +1397,14 @@ class JobScheduler:
         avail, total, alive = self.meta.snapshot()
 
         ordered = self._priority_sort(candidates, now)
+        for job in ordered:
+            # spec epoch for the lock-free solve window: modify_job
+            # REPLACES job.spec (dataclasses.replace), so object
+            # identity detects any mid-solve modification — _commit
+            # voids the placement of a job whose spec changed (e.g. a
+            # partition move validated against the NEW partition while
+            # the solve placed it in the OLD one)
+            job._plan_spec = job.spec
         jobs_batch, max_nodes = self._build_batch(ordered, avail.shape[0],
                                                   now)
         cost0 = self._ledger.cost0(now, total.shape[0])
@@ -1387,8 +1419,8 @@ class JobScheduler:
         if packed:
             state = make_cluster_state(avail, total, alive, cost0)
             pbatch = self._packed_batch(jobs_batch, ordered)
-            placements, _ = solve_packed(state, pbatch,
-                                         max_nodes=max_nodes)
+            placements = yield (lambda: solve_packed(
+                state, pbatch, max_nodes=max_nodes)[0])
             started = self._commit(ordered, placements, now,
                                    tasks=np.asarray(placements.tasks))
             started += self._try_preemption(ordered, now)
@@ -1399,7 +1431,7 @@ class JobScheduler:
         if self.config.backfill:
             bf_max = max(1, self.config.backfill_max_jobs)
             if len(ordered) > bf_max:
-                started = self._split_backfill_cycle(
+                started = yield from self._split_backfill_phases(
                     ordered, jobs_batch, avail, total, alive, cost0,
                     max_nodes, now)
                 started += self._try_preemption(ordered, now)
@@ -1410,12 +1442,13 @@ class JobScheduler:
                 return started
             state = self._timed_state(now, avail, total, alive, cost0)
             tbatch = self._timed_batch(jobs_batch, ordered)
-            placements, _ = solve_backfill(state, tbatch,
-                                           max_nodes=max_nodes)
+            placements = yield (lambda: solve_backfill(
+                state, tbatch, max_nodes=max_nodes)[0])
             start_buckets = np.asarray(placements.start_bucket)
         else:
-            placements, solver_name = self._immediate_solve(
-                avail, total, alive, cost0, jobs_batch, max_nodes)
+            placements, solver_name = yield (
+                lambda: self._immediate_solve(
+                    avail, total, alive, cost0, jobs_batch, max_nodes))
             start_buckets = None
 
         started = self._commit(ordered, placements, now, start_buckets)
@@ -1452,9 +1485,8 @@ class JobScheduler:
                                          max_nodes=max_nodes)
         return placements, solver_name
 
-    def _split_backfill_cycle(self, ordered, jobs_batch, avail, total,
-                              alive, cost0, max_nodes, now
-                              ) -> list[int]:
+    def _split_backfill_phases(self, ordered, jobs_batch, avail, total,
+                               alive, cost0, max_nodes, now):
         """Bounded backfill lookahead (Slurm's sched/bf split): the
         timed solve with full reservation semantics covers only the top
         ``backfill_max_jobs`` priority jobs; the tail is placed by the
@@ -1483,18 +1515,22 @@ class JobScheduler:
         tail_batch = jobs_batch.replace(valid=tail_valid)
 
         state = self._timed_state(now, avail, total, alive, cost0)
-        placements, tstate = solve_backfill(
-            state, self._timed_batch(head_batch, head),
-            max_nodes=max_nodes)
+        tb = self._timed_batch(head_batch, head)
+        placements, tstate = yield (
+            lambda: solve_backfill(state, tb, max_nodes=max_nodes))
         started = self._commit(head, placements, now,
                                np.asarray(placements.start_bucket))
 
         # pass 2: the tail against the tightest bucket of the horizon
-        min_avail = np.asarray(jnp.min(tstate.time_avail, axis=1))
-        cost1 = np.asarray(tstate.cost)
         self.meta.start_logging()   # fresh event window for this commit
-        placements2, _ = self._immediate_solve(
-            min_avail, total, alive, cost1, tail_batch, max_nodes)
+
+        def _tail_solve():
+            min_avail = np.asarray(jnp.min(tstate.time_avail, axis=1))
+            cost1 = np.asarray(tstate.cost)
+            return self._immediate_solve(
+                min_avail, total, alive, cost1, tail_batch, max_nodes)
+
+        placements2, _ = yield _tail_solve
         tail_placements = Placements(
             placed=placements2.placed[bf_max:],
             nodes=placements2.nodes[bf_max:],
@@ -2218,6 +2254,15 @@ class JobScheduler:
         reasons = np.asarray(placements.reason)
         started: list[int] = []
         for i, job in enumerate(ordered):
+            if (job.job_id not in self.pending or job.held
+                    or job.spec is not getattr(job, "_plan_spec",
+                                               job.spec)):
+                # canceled / finalized / held / modified while the
+                # solve ran outside the lock (cycle_phases): its
+                # placement is void; resources were never committed
+                # so nothing to undo.  The job stays pending for the
+                # next cycle, which sees the new spec.
+                continue
             if not placed[i]:
                 job.pending_reason = _REASON_MAP.get(
                     int(reasons[i]), PendingReason.RESOURCE)

@@ -85,11 +85,24 @@ def classes_from_part_mask(part_mask) -> tuple[np.ndarray, np.ndarray]:
     return inverse.astype(np.int32), classes
 
 
-def _make_kernel(BJ: int, K: int, R: int, W: int):
-    # all per-job scalars ride in ONE SMEM window (layout [BJ, R+4]:
-    # req dims, node_num, time_limit, valid, class) — SMEM windows are
-    # padded to 1 KiB/row and double-buffered, so five separate arrays
-    # blow the ~1 MiB SMEM budget while one fits comfortably
+def _make_kernel(BJ: int, K: int, R: int, W: int, S: int = 1):
+    # all per-job scalars ride in ONE SMEM window (layout [S, R+4, BJ]:
+    # req dims, node_num, time_limit, valid, class as ROWS, jobs as
+    # columns) — SMEM windows are padded to 1 KiB/row and
+    # double-buffered, so the fields-as-rows orientation costs
+    # S*(R+4) padded rows instead of S*BJ (1024 rows = a full MiB of
+    # SMEM, measured OOM at S=4, BJ=256).
+    #
+    # S is the number of INDEPENDENT job streams processed per loop
+    # iteration.  Streams own pairwise-disjoint eligibility classes
+    # (verified host-side), so their greedy decisions never interact:
+    # selections of all S streams are mutually independent and their
+    # latency chains overlap (the kernel is latency-bound on each
+    # job's compare→min-reduce→update dependency chain, NOT on vector
+    # width — measured: quartering the node axis changed per-job time
+    # by <4%, tools/kexp.py).  This is the TPU analog of the
+    # reference's per-partition LocalScheduler split
+    # (src/CraneCtld/JobScheduler.cpp:6516-6530).
     def kernel(job_s, nelig_s,                           # SMEM scalars
                avail_in, cost_in, elig_in, cputot_in,    # VMEM cluster in
                placed_o, chosen_o, reason_o, avail_o, cost_o,  # outputs
@@ -111,81 +124,100 @@ def _make_kernel(BJ: int, K: int, R: int, W: int):
         inf = jnp.int32(COST_INF)
         npad = jnp.int32(SUB * W)
 
-        placed_s[...] = jnp.zeros((1, BJ), jnp.int32)
-        reason_s[...] = jnp.zeros((1, BJ), jnp.int32)
-        chosen_s[...] = jnp.full((K, BJ), -1, jnp.int32)
+        placed_s[...] = jnp.zeros((S, BJ), jnp.int32)
+        reason_s[...] = jnp.zeros((S, BJ), jnp.int32)
+        chosen_s[...] = jnp.full((S, K, BJ), -1, jnp.int32)
 
         def job_body(j, carry):
-            nn = job_s[j, R]
-            tl = job_s[j, R + 1]
-            valid = job_s[j, R + 2] != 0
-            cls = job_s[j, R + 3]
+            # --- selection phase: all S streams first, so the S
+            # latency-heavy reduce chains are mutually independent ---
+            sels = []
+            for c in range(S):
+                nn = job_s[c, R, j]
+                valid = job_s[c, R + 2, j] != 0
+                cls = job_s[c, R + 3, j]
 
-            feas = elig_in[cls] != 0                     # [SUB, W]
-            for r in range(R):
-                feas = feas & (avail_s[r] >= job_s[j, r])
+                feas = elig_in[cls] != 0                 # [SUB, W]
+                for r in range(R):
+                    feas = feas & (avail_s[r] >= job_s[c, r, j])
 
-            # --- selection pass: K masked mins (reduction-only) ---
-            mcost = jnp.where(feas, cost_s[0], inf)      # [SUB, W]
-            ms, idxs = [], []
-            for k in range(K):
-                m = jnp.min(mcost)
-                idx = jnp.min(jnp.where(mcost == m, nid, npad))
-                ms.append(m)
-                idxs.append(idx)
-                # mask the winner for the next gang member (cheapest_k
-                # masks unconditionally; on an all-INF row the mask is
-                # a no-op, same as cheapest_k)
-                if k + 1 < K:
-                    mcost = jnp.where(nid == idx, inf, mcost)
+                # K masked mins (reduction-only)
+                mcost = jnp.where(feas, cost_s[0], inf)  # [SUB, W]
+                ms, idxs = [], []
+                for k in range(K):
+                    m = jnp.min(mcost)
+                    idx = jnp.min(jnp.where(mcost == m, nid, npad))
+                    ms.append(m)
+                    idxs.append(idx)
+                    # mask the winner for the next gang member
+                    # (cheapest_k masks unconditionally; on an all-INF
+                    # row the mask is a no-op, same as cheapest_k)
+                    if k + 1 < K:
+                        mcost = jnp.where(nid == idx, inf, mcost)
+                sels.append((nn, valid, cls, ms, idxs))
 
-            # --- admission (decide_job): the masked minima are sorted
-            # ascending, so "at least nn feasible nodes" is exactly "at
-            # least nn finite minima" — no O(N) popcount needed.  The
-            # eligible count is solve-invariant and precomputed per
-            # class host-side.
-            cnt_finite = jnp.int32(0)
-            for k in range(K):
-                cnt_finite = cnt_finite + (ms[k] < inf).astype(jnp.int32)
-            ok = valid & (nn > 0) & (nn <= K) & (cnt_finite >= nn)
-            bad = jnp.logical_not(valid) | (nn <= 0)
-            never = bad | (nelig_s[cls, 0] < nn)
-            reason = jnp.where(ok, REASON_NONE,
-                               jnp.where(never, REASON_CONSTRAINT,
-                                         REASON_RESOURCE))
+            # --- decide + update phase.  Updates touch only the
+            # stream's own (disjoint) nodes, so stream order here is
+            # immaterial; selections above read pre-update state,
+            # which is exact because no other stream can touch the
+            # nodes this stream sees. ---
+            for c in range(S):
+                nn, valid, cls, ms, idxs = sels[c]
+                tl = job_s[c, R + 1, j]
 
-            # --- one combined update for all gang members ---
-            win = jnp.zeros((SUB, W), bool)
-            for k in range(K):
-                take = ok & (k < nn) & (ms[k] < inf)
-                win = win | ((nid == idxs[k]) & take)
-                chosen_s[k:k + 1, :] = jnp.where(
-                    (jlane == j) & take, idxs[k], chosen_s[k:k + 1, :])
-            # MinCpuTimeRatioFirst increment, elementwise over nodes
-            # with this job's scalars — identical f32 expression (and
-            # associativity) to solver.quantized_dcost
-            dcost = jnp.round(
-                tl.astype(jnp.float32)
-                * job_s[j, DIM_CPU].astype(jnp.float32)
-                * jnp.float32(COST_SCALE)
-                / cputot_in[0]).astype(jnp.int32)
-            for r in range(R):
-                avail_s[r] = avail_s[r] - jnp.where(win, job_s[j, r], 0)
-            cost_s[0] = cost_s[0] + jnp.where(win, dcost, 0)
+                # admission (decide_job): the masked minima are sorted
+                # ascending, so "at least nn feasible nodes" is
+                # exactly "at least nn finite minima" — no O(N)
+                # popcount.  The eligible count is solve-invariant and
+                # precomputed per class host-side.
+                cnt_finite = jnp.int32(0)
+                for k in range(K):
+                    cnt_finite = (cnt_finite
+                                  + (ms[k] < inf).astype(jnp.int32))
+                ok = valid & (nn > 0) & (nn <= K) & (cnt_finite >= nn)
+                bad = jnp.logical_not(valid) | (nn <= 0)
+                never = bad | (nelig_s[cls, 0] < nn)
+                reason = jnp.where(ok, REASON_NONE,
+                                   jnp.where(never, REASON_CONSTRAINT,
+                                             REASON_RESOURCE))
 
-            placed_s[...] = jnp.where(jlane == j, ok.astype(jnp.int32),
-                                      placed_s[...])
-            reason_s[...] = jnp.where(jlane == j, reason, reason_s[...])
+                # one combined update for all gang members
+                win = jnp.zeros((SUB, W), bool)
+                for k in range(K):
+                    take = ok & (k < nn) & (ms[k] < inf)
+                    win = win | ((nid == idxs[k]) & take)
+                    chosen_s[c, k:k + 1, :] = jnp.where(
+                        (jlane == j) & take, idxs[k],
+                        chosen_s[c, k:k + 1, :])
+                # MinCpuTimeRatioFirst increment, elementwise over
+                # nodes with this job's scalars — identical f32
+                # expression (and associativity) to
+                # solver.quantized_dcost
+                dcost = jnp.round(
+                    tl.astype(jnp.float32)
+                    * job_s[c, DIM_CPU, j].astype(jnp.float32)
+                    * jnp.float32(COST_SCALE)
+                    / cputot_in[0]).astype(jnp.int32)
+                for r in range(R):
+                    avail_s[r] = avail_s[r] - jnp.where(
+                        win, job_s[c, r, j], 0)
+                cost_s[0] = cost_s[0] + jnp.where(win, dcost, 0)
+
+                placed_s[c:c + 1, :] = jnp.where(
+                    jlane == j, ok.astype(jnp.int32),
+                    placed_s[c:c + 1, :])
+                reason_s[c:c + 1, :] = jnp.where(
+                    jlane == j, reason, reason_s[c:c + 1, :])
             return carry
 
         jax.lax.fori_loop(0, BJ, job_body, jnp.int32(0))
 
         # per-job outputs live whole in VMEM (tiny); write this block's
         # row at a dynamic offset — blocked specs would need a
-        # sublane-divisible leading block dim the (NB, BJ) shape lacks
-        placed_o[pl.ds(step, 1), :] = placed_s[...]
-        chosen_o[pl.ds(step, 1), :, :] = chosen_s[...][None]
-        reason_o[pl.ds(step, 1), :] = reason_s[...]
+        # sublane-divisible leading block dim the (NB, S, BJ) shape lacks
+        placed_o[pl.ds(step, 1)] = placed_s[...][None]
+        chosen_o[pl.ds(step, 1)] = chosen_s[...][None]
+        reason_o[pl.ds(step, 1)] = reason_s[...][None]
 
         @pl.when(step == nb - 1)
         def _():
@@ -195,29 +227,14 @@ def _make_kernel(BJ: int, K: int, R: int, W: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes", "block_jobs",
-                                             "interpret"))
-def solve_greedy_pallas(state: ClusterState, req, node_num, time_limit,
-                        valid, job_class, class_masks,
-                        max_nodes: int = 1, block_jobs: int = 256,
-                        interpret: bool = False
-                        ) -> tuple[Placements, ClusterState]:
-    """Single-kernel greedy solve.  Same contract as ``solve_greedy``
-    with eligibility given as (job_class, class_masks); returns
-    (Placements, new ClusterState)."""
-    J = req.shape[0]
+def _fold_cluster(state: ClusterState, class_masks):
+    """Node-axis tensors folded to [.., SUB, W] + per-class eligible
+    counts; shared by the serial and streamed entry points."""
     N = state.num_nodes
     R = state.num_dims
-    K = min(max_nodes, N)
-    BJ = block_jobs
-
+    C = class_masks.shape[0]
     n_pad = -(-N // NODE_TILE) * NODE_TILE
     W = n_pad // SUB
-    j_pad = -(-max(J, 1) // BJ) * BJ
-    NB = j_pad // BJ
-    C = class_masks.shape[0]
-
-    # --- node-axis tensors, folded to [.., SUB, W] ---
     availT = _pad_to(state.avail.T.astype(jnp.int32), n_pad, 1, 0)
     avail3 = availT.reshape(R, SUB, W)
     cost2 = _pad_to(state.cost.astype(jnp.int32)[None, :], n_pad, 1,
@@ -227,66 +244,216 @@ def solve_greedy_pallas(state: ClusterState, req, node_num, time_limit,
     nelig = jnp.sum(elig, axis=1, dtype=jnp.int32)[:, None]  # [C, 1]
     cputot = jnp.maximum(state.total[:, DIM_CPU], 1).astype(jnp.float32)
     cputot3 = _pad_to(cputot[None, :], n_pad, 1, 1.0).reshape(1, SUB, W)
+    return n_pad, W, avail3, cost2, elig3, nelig, cputot3
 
-    # --- job scalars, padded to NB * BJ ---
-    def padj(x, value=0):
-        return _pad_to(jnp.asarray(x), j_pad, 0, value)
 
-    job_p = padj(jnp.concatenate([
+def _job_scalars(req, node_num, time_limit, valid, job_class, C):
+    return jnp.concatenate([
         req.astype(jnp.int32),
         node_num.astype(jnp.int32)[:, None],
         time_limit.astype(jnp.int32)[:, None],
         valid.astype(jnp.int32)[:, None],
         jnp.clip(job_class.astype(jnp.int32), 0, C - 1)[:, None],
-    ], axis=1))                                        # [Jp, R + 4]
+    ], axis=1)                                         # [J, R + 4]
 
-    def smem_j(width):
-        return pl.BlockSpec((BJ, width), lambda i: (i, 0),
-                            memory_space=pltpu.SMEM)
 
+def _launch(job_p, nelig, avail3, cost2, elig3, cputot3,
+            S, NB, BJ, K, R, W, C, interpret):
+    """pallas_call plumbing shared by both entry points.  job_p is
+    [S, NB*BJ, R+4]; returns raw blocked outputs + final ledgers."""
     def vmem_full():
         return pl.BlockSpec(memory_space=pltpu.VMEM)
 
     out_shapes = (
-        jax.ShapeDtypeStruct((NB, BJ), jnp.int32),        # placed
-        jax.ShapeDtypeStruct((NB, K, BJ), jnp.int32),     # chosen
-        jax.ShapeDtypeStruct((NB, BJ), jnp.int32),        # reason
+        jax.ShapeDtypeStruct((NB, S, BJ), jnp.int32),     # placed
+        jax.ShapeDtypeStruct((NB, S, K, BJ), jnp.int32),  # chosen
+        jax.ShapeDtypeStruct((NB, S, BJ), jnp.int32),     # reason
         jax.ShapeDtypeStruct((R, SUB, W), jnp.int32),     # avail out
         jax.ShapeDtypeStruct((1, SUB, W), jnp.int32),     # cost out
     )
-    out_specs = (
-        pl.BlockSpec(memory_space=pltpu.VMEM),
-        pl.BlockSpec(memory_space=pltpu.VMEM),
-        pl.BlockSpec(memory_space=pltpu.VMEM),
-        pl.BlockSpec(memory_space=pltpu.VMEM),
-        pl.BlockSpec(memory_space=pltpu.VMEM),
-    )
-    placed, chosen, reason, avail_f, cost_f = pl.pallas_call(
-        _make_kernel(BJ, K, R, W),
+    return pl.pallas_call(
+        _make_kernel(BJ, K, R, W, S),
         grid=(NB,),
-        in_specs=[smem_j(R + 4),
+        in_specs=[pl.BlockSpec((S, R + 4, BJ), lambda i: (0, 0, i),
+                               memory_space=pltpu.SMEM),
                   pl.BlockSpec((C, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
                   vmem_full(), vmem_full(), vmem_full(), vmem_full()],
         out_shape=out_shapes,
-        out_specs=out_specs,
+        out_specs=tuple(pl.BlockSpec(memory_space=pltpu.VMEM)
+                        for _ in out_shapes),
         scratch_shapes=[
             pltpu.VMEM((R, SUB, W), jnp.int32),
             pltpu.VMEM((1, SUB, W), jnp.int32),
-            pltpu.VMEM((1, BJ), jnp.int32),
-            pltpu.VMEM((K, BJ), jnp.int32),
-            pltpu.VMEM((1, BJ), jnp.int32),
+            pltpu.VMEM((S, BJ), jnp.int32),
+            pltpu.VMEM((S, K, BJ), jnp.int32),
+            pltpu.VMEM((S, BJ), jnp.int32),
         ],
         interpret=interpret,
     )(job_p, nelig, avail3, cost2, elig3, cputot3)
 
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "block_jobs",
+                                             "interpret"))
+def solve_greedy_pallas(state: ClusterState, req, node_num, time_limit,
+                        valid, job_class, class_masks,
+                        max_nodes: int = 1, block_jobs: int = 256,
+                        interpret: bool = False
+                        ) -> tuple[Placements, ClusterState]:
+    """Single-kernel greedy solve (one serial job stream).  Same
+    contract as ``solve_greedy`` with eligibility given as
+    (job_class, class_masks); returns (Placements, new ClusterState)."""
+    J = req.shape[0]
+    N = state.num_nodes
+    R = state.num_dims
+    K = min(max_nodes, N)
+    BJ = block_jobs
+
+    j_pad = -(-max(J, 1) // BJ) * BJ
+    NB = j_pad // BJ
+    C = class_masks.shape[0]
+    n_pad, W, avail3, cost2, elig3, nelig, cputot3 = _fold_cluster(
+        state, class_masks)
+
+    job_p = _pad_to(_job_scalars(req, node_num, time_limit, valid,
+                                 job_class, C), j_pad, 0, 0).T[None]
+
+    placed, chosen, reason, avail_f, cost_f = _launch(
+        job_p, nelig, avail3, cost2, elig3, cputot3,
+        1, NB, BJ, K, R, W, C, interpret)
+
     placed = placed.reshape(-1)[:J].astype(bool)
-    nodes = chosen.transpose(0, 2, 1).reshape(-1, K)[:J]
+    nodes = chosen.reshape(NB, K, BJ).transpose(0, 2, 1).reshape(-1, K)[:J]
     reason = reason.reshape(-1)[:J]
     avail_new = avail_f.reshape(R, n_pad)[:, :N].T
     cost_new = cost_f.reshape(n_pad)[:N]
     new_state = state.replace(avail=avail_new, cost=cost_new)
     return Placements(placed=placed, nodes=nodes, reason=reason), new_state
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "max_nodes", "block_jobs", "num_streams", "stream_len", "interpret"))
+def _solve_streamed(state: ClusterState, req, node_num, time_limit,
+                    valid, job_class, class_masks, stream_of_class,
+                    max_nodes: int, block_jobs: int, num_streams: int,
+                    stream_len: int, interpret: bool
+                    ) -> tuple[Placements, ClusterState]:
+    """S-stream greedy solve: jobs are regrouped per stream (classes
+    were packed into streams host-side; disjointness verified there),
+    solved with the streamed kernel, and scattered back to the
+    original order.  Bit-identical to the serial path whenever the
+    streams' class masks are pairwise disjoint."""
+    J = req.shape[0]
+    N = state.num_nodes
+    R = state.num_dims
+    K = min(max_nodes, N)
+    BJ = block_jobs
+    S = num_streams
+    L = stream_len                    # padded per-stream length
+    NB = L // BJ
+    C = class_masks.shape[0]
+    n_pad, W, avail3, cost2, elig3, nelig, cputot3 = _fold_cluster(
+        state, class_masks)
+
+    cls = jnp.clip(job_class.astype(jnp.int32), 0, C - 1)
+    stream = stream_of_class[cls]                       # [J]
+    order = jnp.argsort(stream, stable=True)            # orig ids, stream-major
+    sorted_stream = stream[order]
+    # slot within stream = position among same-stream jobs (original
+    # relative order preserved — the within-class greedy order)
+    slot = (jnp.arange(J, dtype=jnp.int32)
+            - jnp.searchsorted(sorted_stream,
+                               sorted_stream).astype(jnp.int32))
+    lin = sorted_stream * L + slot                      # [J] flat slots
+
+    scal = _job_scalars(req, node_num, time_limit, valid, cls, C)
+    job_p = jnp.zeros((S * L, R + 4), jnp.int32).at[lin].set(
+        scal[order], mode="drop")
+    job_p = job_p.reshape(S, L, R + 4).transpose(0, 2, 1)
+
+    placed, chosen, reason, avail_f, cost_f = _launch(
+        job_p, nelig, avail3, cost2, elig3, cputot3,
+        S, NB, BJ, K, R, W, C, interpret)
+
+    # [NB, S, ..] -> [S, NB, ..] -> flat [S * L, ..], then gather each
+    # original job's slot
+    placed_f = placed.transpose(1, 0, 2).reshape(-1)
+    reason_f = reason.transpose(1, 0, 2).reshape(-1)
+    chosen_f = chosen.transpose(1, 0, 3, 2).reshape(-1, K)
+    inv = jnp.zeros(J, jnp.int32).at[order].set(lin, mode="drop")
+    placed_j = placed_f[inv].astype(bool)
+    reason_j = reason_f[inv]
+    nodes_j = chosen_f[inv]
+
+    avail_new = avail_f.reshape(R, n_pad)[:, :N].T
+    cost_new = cost_f.reshape(n_pad)[:N]
+    new_state = state.replace(avail=avail_new, cost=cost_new)
+    return (Placements(placed=placed_j, nodes=nodes_j, reason=reason_j),
+            new_state)
+
+
+def plan_streams(job_class, class_masks, max_streams: int = 4,
+                 block_jobs: int = 256):
+    """Host-side stream planner.  Returns (stream_of_class[C],
+    num_streams, stream_len) when the class masks are pairwise
+    disjoint and the packing is worthwhile, else None (caller uses the
+    serial kernel).  Classes are LPT-packed into at most
+    ``max_streams`` streams balanced by job count; stream_len is the
+    max stream job count rounded up to a block multiple (and to a
+    power-of-two-ish quantum to bound recompiles across cycles)."""
+    cm = np.asarray(class_masks).astype(bool)
+    C = cm.shape[0]
+    if C < 2 or max_streams < 2:
+        return None
+    if (cm.sum(axis=0) > 1).any():
+        return None                 # overlapping eligibility: serial
+    counts = np.bincount(np.asarray(job_class), minlength=C)[:C]
+    S = min(max_streams, int((counts > 0).sum()))
+    if S < 2:
+        return None
+    # LPT: biggest class first onto the lightest stream
+    load = np.zeros(S, np.int64)
+    stream_of_class = np.zeros(C, np.int32)
+    for c in np.argsort(-counts):
+        s = int(np.argmin(load))
+        stream_of_class[c] = s
+        load[s] += int(counts[c])
+    longest = int(load.max())
+    total = int(counts.sum())
+    if longest * 2 > total:
+        return None                 # too skewed: streams mostly padding
+    stream_len = -(-max(longest, 1) // block_jobs) * block_jobs
+    # quantize to 1.25^k block counts so shifting workloads reuse
+    # compiled kernels instead of recompiling every cycle
+    nb = stream_len // block_jobs
+    q = 1
+    while q < nb:
+        q = max(q + 1, int(q * 1.25))
+    stream_len = q * block_jobs
+    return jnp.asarray(stream_of_class), S, stream_len
+
+
+def solve_greedy_pallas_auto(state: ClusterState, req, node_num,
+                             time_limit, valid, job_class, class_masks,
+                             max_nodes: int = 1, block_jobs: int = 256,
+                             max_streams: int = 4,
+                             interpret: bool = False
+                             ) -> tuple[Placements, ClusterState]:
+    """Dispatch: streamed kernel when eligibility classes are disjoint
+    and balanced enough to profit, serial single-kernel otherwise.
+    Semantics are identical either way (tests/test_pallas_parity.py)."""
+    plan = plan_streams(job_class, class_masks, max_streams=max_streams,
+                        block_jobs=block_jobs)
+    if plan is None:
+        return solve_greedy_pallas(
+            state, req, node_num, time_limit, valid, job_class,
+            class_masks, max_nodes=max_nodes, block_jobs=block_jobs,
+            interpret=interpret)
+    stream_of_class, S, L = plan
+    return _solve_streamed(
+        state, req, node_num, time_limit, valid, job_class, class_masks,
+        stream_of_class, max_nodes=max_nodes, block_jobs=block_jobs,
+        num_streams=S, stream_len=L, interpret=interpret)
 
 
 def solve_greedy_pallas_from_batch(state: ClusterState, jobs: JobBatch,

@@ -257,9 +257,16 @@ class CforedServer:
         else:
             port = self._server.add_insecure_port(address)
         self._server.start()
-        # tls:// marks the advertised address so craneds know the
-        # supervisor must dial back with the cluster CA
-        scheme = "tls://" if self.tls is not None else ""
+        # tls://<identity>@ marks the advertised address so craneds
+        # know the supervisor must dial back with the cluster CA AND
+        # can pin the hub's issued cert name — without the pin, any
+        # cluster-issued cert validates as the hub on loopback hosts
+        # (every cert carries localhost SANs for single-host setups)
+        scheme = ""
+        if self.tls is not None:
+            from cranesched_tpu.utils.pki import cert_identity
+            ident = cert_identity(self.tls.cert) if self.tls.cert else ""
+            scheme = f"tls://{ident}@" if ident else "tls://"
         self.address = f"{scheme}{host_for_clients}:{port}"
         return self.address
 

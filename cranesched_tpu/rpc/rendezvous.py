@@ -52,9 +52,15 @@ class RendezvousServer:
     the barrier (the final ranks' RPCs queue behind the parked ones
     and the fence times out at N_pool/N arrived)."""
 
-    def __init__(self, token: str = "", nranks: int = 0):
+    def __init__(self, token: str = "", nranks: int = 0, tls=None):
         self.token = token
         self.nranks = nranks
+        # utils.pki.TlsConfig (the hosting node's cluster cert): when
+        # set, the service serves TLS so the per-gang bearer token and
+        # modex/fence payloads never ride plaintext node-to-node in
+        # TLS-enabled clusters (members dial with the cluster CA via
+        # CRANE_RENDEZVOUS_CA)
+        self.tls = tls
         self._kv: dict[str, bytes] = {}
         self._kv_cond = threading.Condition()
         self._fences: dict[str, _FenceState] = {}
@@ -156,7 +162,12 @@ class RendezvousServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(RDZV_SERVICE,
                                                   handlers),))
-        self.port = self._server.add_insecure_port(address)
+        if self.tls is not None:
+            from cranesched_tpu.utils.pki import server_credentials
+            self.port = self._server.add_secure_port(
+                address, server_credentials(self.tls))
+        else:
+            self.port = self._server.add_insecure_port(address)
         if not self.port:
             # grpc returns 0 on bind failure instead of raising; a
             # silent no-listener server would strand the gang with
@@ -184,10 +195,10 @@ class RendezvousClient:
     """Member-side stub (used by cranesched_tpu.coord) — the shared
     GrpcStub plumbing with the gang-token header."""
 
-    def __init__(self, address: str, token: str = ""):
+    def __init__(self, address: str, token: str = "", tls=None):
         from cranesched_tpu.rpc.stub import GrpcStub
         self._stub = GrpcStub(address, RDZV_SERVICE, token=token,
-                              token_key="crane-rdzv-token")
+                              token_key="crane-rdzv-token", tls=tls)
 
     def put(self, key: str, value: bytes) -> None:
         self._stub.call("Put", pb.RdzvPutRequest(key=key, value=value),

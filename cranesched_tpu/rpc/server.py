@@ -328,10 +328,19 @@ class CtldServer:
         return pb.OkReply(ok=ok,
                           error="" if ok else "not a running allocation")
 
+    # default page size for cursor reads that don't set a limit — also
+    # the bare-read archive cap
+    DEFAULT_PAGE = 10_000
+
     def _job_snapshot(self, request) -> tuple[list, dict]:
         """Filtered job list + node-name map, under the lock.  Returns
         refs (cheap); pb conversion happens in bounded chunks so large
         queues never pin the scheduler for the whole result set."""
+        if request.after_job_id and not request.limit:
+            # a cursor without a limit gets the default page size — so
+            # the handlers' truncation math (limit-based) marks the
+            # reply truncated instead of silently dropping the tail
+            request.limit = self.DEFAULT_PAGE
         names = {i: n.name
                  for i, n in self.scheduler.meta.nodes.items()}
         jobs = list(self.scheduler.queue())
@@ -353,12 +362,15 @@ class CtldServer:
                 # full final page from a continued one.  Bare reads
                 # keep the newest-10k cap.
                 paged = bool(request.limit or request.after_job_id)
+                # cursor reads always carry a limit here (normalized
+                # above): limit+1 rows let the truncated flag tell a
+                # full final page from a continued one
                 jobs += [j for j in self.scheduler.archive.query(
                              job_ids=list(request.job_ids),
                              user=request.user,
                              partition=request.partition,
-                             limit=(request.limit + 1 if request.limit
-                                    else 0) if paged else 10_000,
+                             limit=(request.limit + 1 if paged
+                                    else self.DEFAULT_PAGE),
                              after_job_id=request.after_job_id,
                              keyset=paged)
                          if j.job_id not in seen]
@@ -826,13 +838,33 @@ class CtldServer:
         return port
 
     def _cycle_loop(self) -> None:
-        """The 1 Hz ScheduleThread_ analog (JobScheduler.cpp:1321,1981)."""
+        """The 1 Hz ScheduleThread_ analog (JobScheduler.cpp:1321,1981).
+
+        Snapshot-in / commit-out: the lock is held only for the
+        scheduler's state phases (prelude, snapshot, commit); each
+        solve closure yielded by ``cycle_phases`` — the expensive 99%
+        of a big cycle — runs with the lock RELEASED, so submits and
+        queries landing mid-cycle wait microseconds, not a full solve
+        (reference: 9 scheduler threads + per-entry-locked maps,
+        JobScheduler.h:1290-1335; here one cycle thread + a lock whose
+        hold time excludes the solve)."""
         while not self._stop.wait(self.cycle_interval):
             now = time.time()
             with self._lock:
                 if self.sim is not None:
                     self.sim.advance_to(now)
-                self.scheduler.schedule_cycle(now)
+                gen = self.scheduler.cycle_phases(now)
+                try:
+                    fn = next(gen)
+                except StopIteration:
+                    continue
+            while True:
+                result = fn()          # lock released: the solve
+                with self._lock:
+                    try:
+                        fn = gen.send(result)
+                    except StopIteration:
+                        break
 
     def stop(self) -> None:
         self._stop.set()

@@ -55,7 +55,7 @@ class GrpcStub:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=reply_cls.FromString)
             self._stubs[("stream", name)] = stub
-        metadata = ((("crane-token", self.token),) if self.token
+        metadata = (((self.token_key, self.token),) if self.token
                     else None)
         return stub(request, timeout=self.STREAM_TIMEOUT,
                     metadata=metadata)

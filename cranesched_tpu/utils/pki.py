@@ -207,6 +207,28 @@ def _read(path: str) -> bytes:
         return fh.read()
 
 
+def cert_identity(cert_path: str) -> str:
+    """The identity a cert was issued under: its first DNS SAN (the
+    ``name`` argument of issue_cert), falling back to the CN.  Used to
+    advertise a pinnable authority alongside a tls:// address so
+    dial-backs can reject other cluster-issued certs (every cert
+    carries loopback SANs for single-host convenience, so bare CA
+    verification accepts ANY cluster cert on 127.0.0.1)."""
+    from cryptography import x509
+    from cryptography.x509.oid import ExtensionOID, NameOID
+    cert = x509.load_pem_x509_certificate(_read(cert_path))
+    try:
+        san = cert.extensions.get_extension_for_oid(
+            ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+        names = san.get_values_for_type(x509.DNSName)
+        if names:
+            return names[0]
+    except x509.ExtensionNotFound:
+        pass
+    cn = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    return cn[0].value if cn else ""
+
+
 def server_credentials(tls: TlsConfig):
     """ssl_server_credentials for a TlsConfig (cert+key required).
     With ``require_client_cert`` the server also verifies peers against

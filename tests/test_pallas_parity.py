@@ -17,6 +17,8 @@ import jax.numpy as jnp
 
 from cranesched_tpu.models.pallas_solver import (
     classes_from_part_mask,
+    plan_streams,
+    solve_greedy_pallas_auto,
     solve_greedy_pallas_from_batch,
 )
 from cranesched_tpu.models.solver import (
@@ -110,6 +112,89 @@ def test_non_multiple_block_and_node_padding():
     rng = np.random.default_rng(13)
     state, jobs = _random_problem(rng, num_jobs=33, num_nodes=17)
     _assert_bit_identical(state, jobs, max_nodes=2)
+
+
+def _assert_auto_bit_identical(state, jobs, max_nodes, max_streams=4):
+    """The auto dispatcher (streamed kernel when classes are disjoint)
+    must match the scan solver bit-for-bit as well."""
+    job_class, masks = classes_from_part_mask(np.asarray(jobs.part_mask))
+    p_ref, s_ref = solve_greedy(state, jobs, max_nodes=max_nodes)
+    p_st, s_st = solve_greedy_pallas_auto(
+        state, jobs.req, jobs.node_num, jobs.time_limit, jobs.valid,
+        jnp.asarray(job_class), jnp.asarray(masks),
+        max_nodes=max_nodes, max_streams=max_streams, interpret=True)
+    np.testing.assert_array_equal(np.asarray(p_ref.placed),
+                                  np.asarray(p_st.placed))
+    np.testing.assert_array_equal(np.asarray(p_ref.nodes),
+                                  np.asarray(p_st.nodes))
+    np.testing.assert_array_equal(np.asarray(p_ref.reason),
+                                  np.asarray(p_st.reason))
+    np.testing.assert_array_equal(np.asarray(s_ref.avail),
+                                  np.asarray(s_st.avail))
+    np.testing.assert_array_equal(np.asarray(s_ref.cost),
+                                  np.asarray(s_st.cost))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_streamed_parity_disjoint_classes(seed):
+    """Bench-like shape: disjoint partitions -> the auto path takes the
+    S-stream kernel; placements must still be bit-identical to the
+    scan solver."""
+    rng = np.random.default_rng(seed)
+    state, jobs = _random_problem(rng, num_jobs=90, num_nodes=60,
+                                  num_classes=4)
+    job_class, masks = classes_from_part_mask(np.asarray(jobs.part_mask))
+    assert plan_streams(job_class, masks) is not None, \
+        "expected the streamed plan for disjoint balanced classes"
+    _assert_auto_bit_identical(state, jobs, max_nodes=2)
+
+
+def test_streamed_parity_tie_pileup():
+    """All costs tied inside each class: lowest-index tie-breaks must
+    survive the stream regroup/scatter round-trip."""
+    rng = np.random.default_rng(5)
+    state, jobs = _random_problem(rng, num_jobs=64, num_nodes=48,
+                                  tie_costs=True, num_classes=4,
+                                  dead_frac=0.0)
+    _assert_auto_bit_identical(state, jobs, max_nodes=2)
+
+
+def test_streamed_parity_skewed_classes_falls_back():
+    """One dominant class: plan_streams refuses (padding would defeat
+    the point) and auto must give the serial kernel's exact result."""
+    rng = np.random.default_rng(9)
+    state, jobs = _random_problem(rng, num_jobs=80, num_nodes=40,
+                                  num_classes=3)
+    job_class = np.zeros(80, np.int32)
+    job_class[:5] = 1
+    node_part = np.asarray(rng.integers(0, 2, 40))
+    part_mask = job_class[:, None] == node_part[None, :]
+    jobs = jobs.replace(part_mask=jnp.asarray(part_mask))
+    jc, masks = classes_from_part_mask(part_mask)
+    assert plan_streams(jc, masks) is None
+    _assert_auto_bit_identical(state, jobs, max_nodes=2)
+
+
+def test_streamed_parity_overlapping_classes_falls_back():
+    """Overlapping eligibility (include-lists spanning partitions):
+    the planner must detect the overlap and auto must fall back."""
+    rng = np.random.default_rng(21)
+    state, jobs = _random_problem(rng, num_jobs=50, num_nodes=30)
+    pm = np.asarray(rng.random((50, 30)) > 0.35)
+    jobs = jobs.replace(part_mask=jnp.asarray(pm))
+    jc, masks = classes_from_part_mask(pm)
+    assert plan_streams(jc, masks) is None
+    _assert_auto_bit_identical(state, jobs, max_nodes=3)
+
+
+def test_streamed_parity_gangs_and_dead_nodes():
+    """Gang jobs (node_num up to K) on the streamed path, with dead
+    nodes thinning each class."""
+    rng = np.random.default_rng(17)
+    state, jobs = _random_problem(rng, num_jobs=70, num_nodes=80,
+                                  num_classes=4, dead_frac=0.2,
+                                  max_nodes=3)
+    _assert_auto_bit_identical(state, jobs, max_nodes=3)
 
 
 def test_classes_from_part_mask_roundtrip():
