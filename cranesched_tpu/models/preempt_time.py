@@ -47,7 +47,7 @@ from cranesched_tpu.models.solver import (
     cheapest_k,
     quantized_dcost,
 )
-from cranesched_tpu.models.solver_time import NO_START
+from cranesched_tpu.models.solver_time import NO_START, end_buckets_for
 from cranesched_tpu.ops.resources import DIM_CPU
 
 
@@ -62,12 +62,12 @@ class TimedVictimRows:
 
 @struct.dataclass
 class TimedPreemptorBatch:
-    """PreemptorBatch + duration in buckets."""
+    """PreemptorBatch over the time grid; the placement window is
+    derived in-solver from time_limit + the grid edges."""
 
     req: jax.Array
     node_num: jax.Array
     time_limit: jax.Array
-    dur_buckets: jax.Array
     part_mask: jax.Array
     exclusive: jax.Array
     can_prey: jax.Array
@@ -82,15 +82,15 @@ class TimedPreemptDecisions:
     evict: jax.Array           # bool[J, V]
 
 
-def _window_ok(fits_t, dur_b):
-    """[N, T] bool -> [N, T] bool: every bucket of [s, s+d) fits (the
-    prefix-sum trick shared with _place_one_timed)."""
+def _window_ok(fits_t, ends):
+    """[N, T] bool -> [N, T] bool: every bucket of [s, ends[s]) fits
+    (the prefix-sum trick shared with _place_one_timed); ``ends`` are
+    the per-start horizon-clipped end buckets from the grid edges."""
     n, T = fits_t.shape
     csum = jnp.concatenate(
         [jnp.zeros((n, 1), jnp.int32),
          jnp.cumsum(fits_t.astype(jnp.int32), axis=1)], axis=1)
     starts = jnp.arange(T, dtype=jnp.int32)
-    ends = jnp.minimum(starts + dur_b, T)
     wlen = ends - starts
     window_sum = (jnp.take_along_axis(csum, ends[None, :], axis=1)
                   - jnp.take_along_axis(csum, starts[None, :], axis=1))
@@ -98,9 +98,9 @@ def _window_ok(fits_t, dur_b):
 
 
 def _whatif_one_timed(time_avail, cost, total, alive, victim_alive,
-                      tv: TimedVictimRows, req, node_num, dur_b,
-                      part_mask, exclusive, can_prey, valid,
-                      max_nodes: int, num_victims: int):
+                      tv: TimedVictimRows, edges, req, node_num,
+                      time_limit, part_mask, exclusive, can_prey,
+                      valid, max_nodes: int, num_victims: int):
     rows = tv.rows
     n, T, r = time_avail.shape
     m = rows.vid.shape[0]
@@ -126,11 +126,12 @@ def _whatif_one_timed(time_avail, cost, total, alive, victim_alive,
     potential = time_avail + pre_sum_t
 
     eligible = alive & part_mask
+    ends = jnp.minimum(end_buckets_for(edges, tgrid, time_limit), T)
     fits_t = jnp.all(req[None, None, :] <= potential, axis=-1)    # [N,T]
-    ok_t = _window_ok(fits_t, dur_b) & eligible[:, None]
+    ok_t = _window_ok(fits_t, ends) & eligible[:, None]
     whole_t = jnp.all(potential == total[:, None, :], axis=-1)
     ok_t = ok_t & jnp.where(exclusive,
-                            _window_ok(whole_t, dur_b), True)
+                            _window_ok(whole_t, ends), True)
 
     counts = jnp.sum(ok_t, axis=0, dtype=jnp.int32)               # [T]
     can = counts >= node_num
@@ -165,7 +166,8 @@ def _whatif_one_timed(time_avail, cost, total, alive, victim_alive,
                        axis=1)                                    # [M,T,R]
     base = time_avail[jnp.clip(rows.node, 0, n - 1)]              # [M,T,R]
     avail_at_row = base + own_excl
-    in_window = (tgrid[None, :] >= s) & (tgrid[None, :] < s + dur_b)
+    e_s = ends[s_safe]
+    in_window = (tgrid[None, :] >= s) & (tgrid[None, :] < e_s)
     short_t = jnp.any(req[None, None, :] > avail_at_row, axis=-1)  # [M,T]
     still_short = jnp.any(short_t & in_window, axis=-1)           # [M]
     evict_row = row_chosen & (still_short | exclusive)
@@ -180,19 +182,25 @@ def _whatif_one_timed(time_avail, cost, total, alive, victim_alive,
     free_delta = (row_freed[:, None, None]
                   * live_t[:, :, None] * rows.alloc[:, None, :])  # [M,T,R]
     time_avail = time_avail.at[rows.node].add(free_delta, mode="drop")
-    return time_avail, ok, s, sel, idx, evict_v, victim_alive & ~evict_v
+    return (time_avail, ok, s, e_s, sel, idx, evict_v,
+            victim_alive & ~evict_v)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("max_nodes", "num_victims"))
 def solve_preempt_timed(time_avail, total, alive, cost,
                         tv: TimedVictimRows, jobs: TimedPreemptorBatch,
-                        num_victims: int, max_nodes: int = 1
+                        num_victims: int, max_nodes: int = 1,
+                        edges=None
                         ) -> tuple[TimedPreemptDecisions, jax.Array]:
     """Greedy what-if over (victims x time) in priority order; returns
-    decisions + the final victim_alive mask."""
+    decisions + the final victim_alive mask.  ``edges`` as in
+    solve_backfill (None = unit-uniform grid)."""
     n, T, r = time_avail.shape
     max_nodes = min(max_nodes, n)
+    if edges is None:
+        edges = jnp.arange(T + 1, dtype=jnp.int32)
+    edges = jnp.asarray(edges, jnp.int32)
     time_avail = jnp.asarray(time_avail, jnp.int32)
     total = jnp.asarray(total, jnp.int32)
     cost = jnp.asarray(cost, jnp.int32)
@@ -200,17 +208,17 @@ def solve_preempt_timed(time_avail, total, alive, cost,
 
     def step(carry, job):
         ta, c, v_alive = carry
-        req, nn, tl, db, pm, ex, prey, v = job
-        ta, ok, s, sel, idx, evict_v, v_alive = _whatif_one_timed(
-            ta, c, total, alive, v_alive, tv, req, nn, db, pm, ex,
-            prey, v, max_nodes, num_victims)
+        req, nn, tl, pm, ex, prey, v = job
+        ta, ok, s, e_s, sel, idx, evict_v, v_alive = _whatif_one_timed(
+            ta, c, total, alive, v_alive, tv, edges, req, nn, tl, pm,
+            ex, prey, v, max_nodes, num_victims)
         # the preemptor's own occupancy: req (or the whole node when
-        # exclusive) over [s, s+d) on the chosen rows
+        # exclusive) over [s, e(s)) on the chosen rows
         safe = jnp.clip(idx, 0, n - 1)
         eff_req = jnp.where(ex, total[safe],
                             jnp.broadcast_to(req, (idx.shape[0],
                                                    req.shape[0])))
-        in_w = (tgrid[None, :] >= s) & (tgrid[None, :] < s + db)  # [1,T]
+        in_w = (tgrid[None, :] >= s) & (tgrid[None, :] < e_s)     # [1,T]
         delta = (sel[:, None, None] * in_w[0][None, :, None]
                  * eff_req[:, None, :])                           # [K,T,R]
         ta = ta.at[jnp.where(sel, idx, n)].add(-delta, mode="drop")
@@ -226,7 +234,7 @@ def solve_preempt_timed(time_avail, total, alive, cost,
     init = (time_avail, cost, jnp.ones(num_victims, bool))
     (ta, c, v_alive), (placed, start, nodes, evict) = jax.lax.scan(
         step, init,
-        (jobs.req, jobs.node_num, jobs.time_limit, jobs.dur_buckets,
+        (jobs.req, jobs.node_num, jobs.time_limit,
          jobs.part_mask, jobs.exclusive, jobs.can_prey, jobs.valid))
     return TimedPreemptDecisions(placed=placed, start_bucket=start,
                                  nodes=nodes, evict=evict), v_alive

@@ -8,15 +8,21 @@ scan in GetNodesAndTrySchedule_ cpp:6278-6291, and the
 earliest time at which node_num nodes are simultaneously free for the
 whole duration window).
 
-Design — the time axis is a uniform bucket grid, not an event map:
+Design — the time axis is a bucket grid defined by boundary times
+``edges[T+1]`` (seconds from now; bucket t covers [edges[t],
+edges[t+1])), not an event map:
 
-* ``time_avail[N, T, R]``: free resources on node n during bucket t, with
-  bucket width ``resolution`` seconds and horizon ``T * resolution``
-  (reference bounds the same scan with kAlgoMaxTimeWindow = 7 days,
-  h:270).  Durations are rounded UP to whole buckets, so all interval
-  arithmetic is exact on the grid and strictly conservative (a job is
-  never placed where the continuous-time reference would refuse it).
-  Slurm's backfill quantizes identically (bf_resolution, default 60 s).
+* ``time_avail[N, T, R]``: free resources on node n during bucket t.
+  The default grid (``TimeGrid``) is 60 s buckets near now — where
+  backfill precision matters — widening geometrically to cover the
+  reference's full ``kAlgoMaxTimeWindow = 7 days`` (h:270) at T = 64,
+  so a job releasing hours out is still visible to backfill and timed
+  preemption.  A uniform grid is the special case of linear edges.
+  Durations round UP to whole buckets (every bucket the continuous
+  interval overlaps must fit the job), so all interval arithmetic is
+  exact on the grid and strictly conservative (a job is never placed
+  where the continuous-time reference would refuse it).  Slurm's
+  backfill quantizes identically (bf_resolution, default 60 s).
 * The map is built in one shot from the running jobs: scatter-add each
   job's per-node release at its end bucket, then a cumulative sum over
   time — no per-node sorted-map surgery.
@@ -48,6 +54,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from cranesched_tpu.models.solver import (
@@ -60,6 +67,83 @@ from cranesched_tpu.models.solver import (
 
 # start_bucket value for jobs that could not be scheduled in the window
 NO_START = 2**30  # plain int: keep module import backend-free
+
+
+class TimeGrid:
+    """Bucket boundaries for the time axis (host-side, NumPy).
+
+    ``edges[T+1]`` int64 seconds from now, edges[0] == 0, strictly
+    increasing.  The first ``linear_head`` buckets are uniform at
+    ``resolution`` (fine near-term backfill, Slurm bf_resolution
+    style); the rest widen geometrically so edges[T] == ``horizon`` —
+    the reference's kAlgoMaxTimeWindow = 7 days (JobScheduler.h:270)
+    at T = 64 instead of the 64-minute uniform window.  With horizon
+    <= T * resolution the grid degenerates to uniform (the exact
+    pre-round-5 semantics)."""
+
+    def __init__(self, num_buckets: int = 64, resolution: float = 60.0,
+                 horizon: float | None = None, linear_head: int = 32):
+        T = int(num_buckets)
+        res = float(resolution)
+        if horizon is None or horizon <= T * res:
+            edges = np.round(np.arange(T + 1) * res).astype(np.int64)
+        else:
+            L = min(max(int(linear_head), 1), T - 1)
+            head = np.round(np.arange(L + 1) * res).astype(np.int64)
+            # geometric tail: res * (r + r^2 + ... + r^(T-L)) covers
+            # horizon - L*res; solve r by bisection
+            need = float(horizon) - L * res
+            G = T - L
+
+            def tail_sum(r):
+                return res * sum(r ** k for k in range(1, G + 1))
+
+            lo, hi = 1.0, 2.0
+            while tail_sum(hi) < need:
+                hi *= 2.0
+            for _ in range(80):
+                mid = (lo + hi) / 2.0
+                if tail_sum(mid) < need:
+                    lo = mid
+                else:
+                    hi = mid
+            r = hi
+            widths = res * np.power(r, np.arange(1, G + 1))
+            tail = head[-1] + np.cumsum(widths)
+            tail[-1] = horizon          # pin the far edge exactly
+            edges = np.concatenate([head, np.round(tail)]).astype(
+                np.int64)
+            # rounding can collapse adjacent coarse edges; enforce
+            # strict monotonicity (widths >= 1 s)
+            for i in range(1, T + 1):
+                if edges[i] <= edges[i - 1]:
+                    edges[i] = edges[i - 1] + 1
+        self.edges = edges
+        self.num_buckets = T
+        self.resolution = res
+
+    def release_bucket(self, remaining_seconds) -> np.ndarray:
+        """Bucket at which a running job's allocation frees: the first
+        boundary >= its remaining time (conservative-late, like the
+        old ceil(rem/res)); never bucket 0 (an overdue job still holds
+        its allocation NOW)."""
+        rem = np.asarray(remaining_seconds)
+        eb = np.searchsorted(self.edges, rem, side="left")
+        return np.maximum(eb, 1).astype(np.int32)
+
+    @property
+    def jnp_edges(self):
+        return jnp.asarray(self.edges, jnp.int32)
+
+
+def end_buckets_for(edges, starts, duration_seconds):
+    """First boundary index >= edges[start] + duration, per start
+    bucket — the buckets a job starting at each candidate start would
+    occupy are [start, end).  ``edges`` int32[T+1], ``starts``
+    int32[S]; duration a scalar (traced ok)."""
+    dur = jnp.maximum(duration_seconds, 1).astype(jnp.int32)
+    t_end = jnp.take(edges, starts) + dur
+    return jnp.searchsorted(edges, t_end, side="left").astype(jnp.int32)
 
 
 @struct.dataclass
@@ -92,8 +176,9 @@ class TimedJobBatch:
 
     req:         int32[J, R]  per-node requirement
     node_num:    int32[J]
-    time_limit:  int32[J]     seconds (drives the cost update)
-    dur_buckets: int32[J]     ceil(time_limit / resolution), in [1, T]
+    time_limit:  int32[J]     seconds; the job's duration on the grid
+                 (windows are derived in-solver from the grid edges)
+                 AND the cost-update driver
     part_mask:   bool[J, N]
     valid:       bool[J]
     """
@@ -101,7 +186,6 @@ class TimedJobBatch:
     req: jax.Array
     node_num: jax.Array
     time_limit: jax.Array
-    dur_buckets: jax.Array
     part_mask: jax.Array
     valid: jax.Array
 
@@ -159,21 +243,25 @@ def make_timed_state(avail, total, alive, run_nodes, run_req,
                              alive=jnp.asarray(alive, bool), cost=cost)
 
 
-def _place_one_timed(time_avail, cost, total, alive, req, node_num,
-                     time_limit, dur_b, part_mask, valid, max_nodes: int):
+def _place_one_timed(time_avail, cost, total, alive, edges, req,
+                     node_num, time_limit, part_mask, valid,
+                     max_nodes: int):
     n, T, r = time_avail.shape
 
     eligible = alive & part_mask
     # does req fit node n during bucket t?
     fits_t = jnp.all(req[None, None, :] <= time_avail, axis=-1)   # [N, T]
-    # prefix-sum trick: all of [s, s+d) fit  <=>  csum[s+d'] - csum[s] == d'
-    # with d' the window clipped to the horizon (buckets past T hold the
-    # steady state, which IS bucket T-1, already inside the clipped window)
+    # prefix-sum trick: all of [s, e) fit  <=>  csum[e'] - csum[s] ==
+    # e' - s, with e the per-start end bucket from the (possibly
+    # non-uniform) grid edges and e' its horizon clip (buckets past T
+    # hold the steady state, which IS bucket T-1, already inside the
+    # clipped window)
     csum = jnp.concatenate(
         [jnp.zeros((n, 1), jnp.int32),
          jnp.cumsum(fits_t.astype(jnp.int32), axis=1)], axis=1)  # [N, T+1]
     starts = jnp.arange(T, dtype=jnp.int32)
-    ends = jnp.minimum(starts + dur_b, T)
+    ends_g = end_buckets_for(edges, starts, time_limit)           # [T]
+    ends = jnp.minimum(ends_g, T)
     wlen = ends - starts
     window_sum = jnp.take_along_axis(csum, ends[None, :], axis=1) - \
         jnp.take_along_axis(csum, starts[None, :], axis=1)
@@ -199,8 +287,9 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
     k_mask = jnp.arange(max_nodes) < node_num
     sel = placed_ok & k_mask & (sel_cost < COST_INF)
 
-    # write allocation/reservation into [s, s+d) of the chosen rows
-    tmask = (starts[None, :] >= s) & (starts[None, :] < s + dur_b)  # [1,T]
+    # write allocation/reservation into [s, e(s)) of the chosen rows
+    e_s = ends[jnp.clip(s, 0, T - 1)]
+    tmask = (starts[None, :] >= s) & (starts[None, :] < e_s)      # [1,T]
     delta = jnp.where(sel[:, None, None],
                       req[None, None, :] * tmask[..., None], 0)   # [K,T,R]
     time_avail = time_avail.at[idx].add(-delta, mode="drop")
@@ -217,9 +306,13 @@ def _place_one_timed(time_avail, cost, total, alive, req, node_num,
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "group"))
 def solve_backfill(state: TimedClusterState, jobs: TimedJobBatch,
-                   max_nodes: int = 1, group: int = 8
+                   edges=None, max_nodes: int = 1, group: int = 8
                    ) -> tuple[TimedPlacements, TimedClusterState]:
     """Greedy in-priority-order scheduling over the time grid.
+
+    ``edges`` are the grid boundary seconds (TimeGrid.jnp_edges);
+    None means a unit-uniform grid (bucket = 1 s — tests that think in
+    bucket units pass time_limit in buckets).
 
     Every schedulable job gets a start bucket and nodes; jobs that must
     wait hold reservations that later jobs cannot violate (conservative
@@ -232,6 +325,9 @@ def solve_backfill(state: TimedClusterState, jobs: TimedJobBatch,
     faster cycles at the 100k x 10k bench shape).
     """
     max_nodes = min(max_nodes, state.num_nodes)
+    if edges is None:
+        edges = jnp.arange(state.num_buckets + 1, dtype=jnp.int32)
+    edges = jnp.asarray(edges, jnp.int32)
     G = max(1, group)
     J = jobs.req.shape[0]
     pad = (-J) % G
@@ -241,19 +337,18 @@ def solve_backfill(state: TimedClusterState, jobs: TimedJobBatch,
         return jnp.pad(x, widths, constant_values=value)
 
     cols = (padj(jobs.req), padj(jobs.node_num), padj(jobs.time_limit),
-            padj(jobs.dur_buckets, value=1), padj(jobs.part_mask),
-            padj(jobs.valid, value=False))
+            padj(jobs.part_mask), padj(jobs.valid, value=False))
     num_groups = (J + pad) // G
     xs = tuple(x.reshape((num_groups, G) + x.shape[1:]) for x in cols)
 
     def step(carry, xg):
         ta, cost = carry
-        greq, gnn, gtl, gdb, gpm, gv = xg
+        greq, gnn, gtl, gpm, gv = xg
         oks, ss, chosens, reasons = [], [], [], []
         for i in range(G):
             ta, cost, ok, s, chosen, reason = _place_one_timed(
-                ta, cost, state.total, state.alive, greq[i], gnn[i],
-                gtl[i], gdb[i], gpm[i], gv[i], max_nodes)
+                ta, cost, state.total, state.alive, edges, greq[i],
+                gnn[i], gtl[i], gpm[i], gv[i], max_nodes)
             oks.append(ok)
             ss.append(s)
             chosens.append(chosen)
