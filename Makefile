@@ -1,0 +1,17 @@
+# Test entry points (README.md "Tests").
+#
+# tier1      — ROADMAP.md's tier-1 verify, verbatim (tools/tier1.sh):
+#              the whole suite on the CPU backend with an 870 s cap;
+#              prints DOTS_PASSED=<n> at the end.
+# tier1-obs  — fast lane: only the observability tests (@pytest.mark.obs
+#              in tests/test_obs.py) — seconds, not minutes.  Use while
+#              iterating on obs/, the cycle trace, or the watchdog.
+
+.PHONY: tier1 tier1-obs
+
+tier1:
+	bash tools/tier1.sh
+
+tier1-obs:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+	  -p no:cacheprovider -p no:xdist -p no:randomly

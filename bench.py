@@ -183,7 +183,7 @@ def main() -> int:
         # recorded backfill number).  T=64 buckets, idle-cluster map
         # (the map build is measured separately in real cycles).
         from cranesched_tpu.models.solver_time import (
-            TimedJobBatch, make_timed_state, solve_backfill)
+            TimeGrid, TimedJobBatch, make_timed_state, solve_backfill)
         tstate = make_timed_state(
             state.avail, state.total, state.alive,
             np.zeros((0, 1), np.int32), np.zeros((0, req.shape[1]),
@@ -192,9 +192,10 @@ def main() -> int:
         tjobs = TimedJobBatch(
             req=jobs.req, node_num=jobs.node_num,
             time_limit=jobs.time_limit,
-            dur_buckets=jnp.clip(jobs.time_limit // 60, 1, 64),
             part_mask=jobs.part_mask, valid=jobs.valid)
-        return solve_backfill(tstate, tjobs, max_nodes=2, group=8)
+        return solve_backfill(tstate, tjobs,
+                              edges=TimeGrid(64, 60.0).jnp_edges,
+                              max_nodes=2, group=8)
 
     def run_backfill_split(bf_max=1024):
         # the production composition for time-axis cycles at scale
@@ -202,7 +203,7 @@ def main() -> int:
         # top bf_max priority jobs, Pallas immediate solve for the tail
         # against the min-over-horizon availability (reservation-safe)
         from cranesched_tpu.models.solver_time import (
-            TimedJobBatch, make_timed_state, solve_backfill)
+            TimeGrid, TimedJobBatch, make_timed_state, solve_backfill)
         tstate = make_timed_state(
             state.avail, state.total, state.alive,
             np.zeros((0, 1), np.int32), np.zeros((0, req.shape[1]),
@@ -212,9 +213,10 @@ def main() -> int:
         tjobs = TimedJobBatch(
             req=head.req, node_num=head.node_num,
             time_limit=head.time_limit,
-            dur_buckets=jnp.clip(head.time_limit // 60, 1, 64),
             part_mask=head.part_mask, valid=head.valid)
-        tp, tstate = solve_backfill(tstate, tjobs, max_nodes=2, group=8)
+        tp, tstate = solve_backfill(tstate, tjobs,
+                                    edges=TimeGrid(64, 60.0).jnp_edges,
+                                    max_nodes=2, group=8)
         min_avail = jnp.min(tstate.time_avail, axis=1)
         tail_state = state.replace(avail=min_avail, cost=tstate.cost)
         p2, _ = solve_greedy_pallas(

@@ -590,9 +590,59 @@ def cmd_cnode(args) -> int:
     return 0
 
 
+def _cstats_stalled(doc) -> str | None:
+    """Client-side stall detection: the last completed cycle is older
+    than a few cycle intervals of server wall clock (tick_mode servers
+    only cycle on demand, so they never count as stalled)."""
+    wd = doc.get("watchdog") or {}
+    if wd.get("tick_mode") or not wd.get("last_cycle_walltime"):
+        return None
+    age = float(wd.get("now", 0.0)) - float(wd["last_cycle_walltime"])
+    limit = max(3.0 * float(wd.get("cycle_interval", 1.0)), 5.0)
+    if age > limit:
+        return (f"scheduler stalled: last completed cycle {age:.1f}s "
+                f"ago (cycle interval {wd.get('cycle_interval')}s)")
+    return None
+
+
 def cmd_cstats(args) -> int:
+    import json as _json
     client = _client(args)
-    print(client.query_stats().json)
+    doc = _json.loads(client.query_stats().json)
+    stalled = _cstats_stalled(doc)
+    if stalled:
+        print(f"WARNING: {stalled}", file=sys.stderr)
+    if doc.get("cycle_crashes_total"):
+        crash = (doc.get("last_crash") or {})
+        print(f"WARNING: {doc['cycle_crashes_total']} scheduler cycle "
+              f"crash(es); last at t={crash.get('time')}",
+              file=sys.stderr)
+    if getattr(args, "cycles", False):
+        rows = [(t.get("now"), t.get("solver"), t.get("queue_depth"),
+                 t.get("candidates"), t.get("placed"),
+                 t.get("backfilled"), t.get("preempted"),
+                 t.get("prelude_ms"), t.get("solve_ms"),
+                 t.get("commit_ms"), t.get("lock_held_ms"),
+                 t.get("total_ms"))
+                for t in doc.get("cycle_trace", [])]
+        print(_fmt_table(rows, (
+            "NOW", "SOLVER", "QUEUE", "CAND", "PLACED", "BACKFILL",
+            "PREEMPT", "PRELUDE_MS", "SOLVE_MS", "COMMIT_MS",
+            "LOCK_MS", "TOTAL_MS")))
+        return 0
+    if getattr(args, "metrics", False):
+        rows = []
+        for name, m in sorted((doc.get("metrics") or {}).items()):
+            for labels, v in sorted(m.get("values", {}).items()):
+                if isinstance(v, dict):   # histogram series
+                    val = (f"count={v.get('count')} "
+                           f"sum={round(float(v.get('sum', 0.0)), 6)}")
+                else:
+                    val = v
+                rows.append((name + labels, m.get("type"), val))
+        print(_fmt_table(rows, ("METRIC", "TYPE", "VALUE")))
+        return 0
+    print(_json.dumps(doc))
     return 0
 
 
@@ -998,6 +1048,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_cnode)
 
     p = sub.add_parser("cstats", help="scheduler cycle statistics")
+    p.add_argument("--cycles", action="store_true",
+                   help="print the last-N cycle trace ring as a table")
+    p.add_argument("--metrics", action="store_true",
+                   help="print the metric registry snapshot as a table")
     p.set_defaults(func=cmd_cstats)
 
     p = sub.add_parser("cacctmgr", help="accounts/users/QoS admin")

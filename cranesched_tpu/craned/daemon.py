@@ -35,6 +35,7 @@ from cranesched_tpu.craned.cgroup import (
     make_cgroups,
     write_pid_to_cgroup,
 )
+from cranesched_tpu.obs import REGISTRY as _OBS
 from cranesched_tpu.ops.resources import gres_key_pair, gres_key_str
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.client import CtldClient
@@ -47,6 +48,23 @@ class CranedState(enum.Enum):
     DISCONNECTED = "Disconnected"
     REGISTERING = "Registering"
     READY = "Ready"
+
+
+# node-plane metrics (naming: ARCHITECTURE.md "Observability")
+_STATE_ORD = {CranedState.DISCONNECTED: 0, CranedState.REGISTERING: 1,
+              CranedState.READY: 2}
+_MET_STATE = _OBS.gauge(
+    "crane_craned_state",
+    "registration FSM state (0=disconnected 1=registering 2=ready)")
+_MET_CTLD_RTT = _OBS.histogram(
+    "crane_craned_ctld_seconds",
+    "register/ping round trip to ctld (label op)")
+_MET_SPAWN = _OBS.histogram(
+    "crane_supervisor_spawn_seconds",
+    "supervisor fork to GO-handshake-complete wall time")
+_MET_CGROUP = _OBS.histogram(
+    "crane_cgroup_op_seconds",
+    "cgroup create/destroy wall time (label op)")
 
 
 class _Alloc:
@@ -110,7 +128,8 @@ class CranedDaemon:
                  prolog: str = "", epilog: str = "",
                  tls=None, tls_name: str = "ctld",
                  container_runtime: str | None = None,
-                 pam_alias: bool = False):
+                 pam_alias: bool = False,
+                 metrics_port: int | None = None):
         self.name = name
         self.ctld_address = ctld_address
         self.cpu = cpu
@@ -168,6 +187,9 @@ class CranedDaemon:
         self.container_runtime = container_runtime
         # publish /var/run/crane/pam.sock (real daemon deployments)
         self.pam_alias = pam_alias
+        # Prometheus /metrics endpoint: None = off, 0 = ephemeral port
+        self.metrics_port = metrics_port
+        self._metrics_server = None
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = make_cgroups(cgroup_root)
@@ -502,6 +524,7 @@ class CranedDaemon:
                 # about to return to the pool and widen devices.allow
                 # with slots it never keeps — kernel state pointing at
                 # resources the ledger thinks are free
+                t0 = time.perf_counter()
                 alloc.procs_path = self.cgroups.create(
                     job_id, cpu=spec.res.cpu,
                     mem_bytes=spec.res.mem_bytes,
@@ -509,6 +532,8 @@ class CranedDaemon:
                     cpuset_cpus=(",".join(map(str, cores))
                                  if cores else ""),
                     allow_devices=allow_rules)
+                _MET_CGROUP.observe(time.perf_counter() - t0,
+                                    op="create")
                 self._persist_registry_locked()
         if winner is not alloc:
             self._release_gres(gres_held)
@@ -530,7 +555,9 @@ class CranedDaemon:
             self._persist_registry_locked()
         self._release_gres(alloc.gres_held)
         self._release_cores(alloc.cores_held)
+        t0 = time.perf_counter()
         self.cgroups.destroy(job_id)
+        _MET_CGROUP.observe(time.perf_counter() - t0, op="destroy")
 
     def _spawn_step(self, request) -> None:
         job_id = request.job_id
@@ -614,6 +641,7 @@ class CranedDaemon:
         env["PYTHONPATH"] = pkg_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
             else "")
+        t_spawn = time.perf_counter()
         proc = subprocess.Popen(
             [sys.executable, "-m", "cranesched_tpu.craned.supervisor"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -694,6 +722,7 @@ class CranedDaemon:
                     f"supervisor handshake failed: {ready!r}")
             proc.stdin.write(b"GO\n")
             proc.stdin.flush()
+            _MET_SPAWN.observe(time.perf_counter() - t_spawn)
         except Exception:
             # every spawn failure must leak nothing: kill AND REAP the
             # process (a cgroup rmdir in the implicit-alloc teardown
@@ -1230,6 +1259,17 @@ class CranedDaemon:
         "ChangeTimeLimit": (pb.TimeLimitRequest, pb.OkReply),
     }
 
+    @property
+    def state(self) -> CranedState:
+        return self._state
+
+    @state.setter
+    def state(self, value: CranedState) -> None:
+        # every FSM transition lands in the gauge, so a flapping node
+        # is visible from /metrics without log spelunking
+        self._state = value
+        _MET_STATE.set(_STATE_ORD.get(value, -1), node=self.name)
+
     def start(self, address: str = "127.0.0.1:0") -> int:
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
@@ -1238,8 +1278,10 @@ class CranedDaemon:
                 response_serializer=reply.SerializeToString)
             for name, (req, reply) in self._RPCS.items()
         }
+        from cranesched_tpu.rpc.interceptors import MetricsInterceptor
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=4))
+            futures.ThreadPoolExecutor(max_workers=4),
+            interceptors=(MetricsInterceptor(plane="craned"),))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(CRANED_SERVICE,
                                                   handlers),))
@@ -1264,6 +1306,10 @@ class CranedDaemon:
         # expectations exchange would treat them as dead
         self._recover_steps()
         self.pam_socket = self._start_pam_socket()
+        if self.metrics_port is not None:
+            from cranesched_tpu.obs import serve_metrics
+            self._metrics_server = serve_metrics(self.metrics_port)
+            self.metrics_port = self._metrics_server.server_address[1]
         threading.Thread(target=self._fsm_loop, daemon=True).start()
         if self.health_program:
             threading.Thread(target=self._health_loop,
@@ -1343,7 +1389,11 @@ class CranedDaemon:
         while not self._stop.is_set():
             if self.state != CranedState.READY:
                 self.state = CranedState.REGISTERING
-                if self._register():
+                t0 = time.perf_counter()
+                registered = self._register()
+                _MET_CTLD_RTT.observe(time.perf_counter() - t0,
+                                      op="register")
+                if registered:
                     self.state = CranedState.READY
                 else:
                     self.state = CranedState.DISCONNECTED
@@ -1351,10 +1401,12 @@ class CranedDaemon:
                     continue
             if self._stop.wait(self.ping_interval):
                 return
+            t0 = time.perf_counter()
             try:
                 ok = self._ctld.craned_ping(self.node_id).ok
             except grpc.RpcError:
                 ok = False
+            _MET_CTLD_RTT.observe(time.perf_counter() - t0, op="ping")
             if not ok:
                 self.state = CranedState.DISCONNECTED
 
@@ -1383,6 +1435,9 @@ class CranedDaemon:
             elif step.proc is not None and not orphan_supervisors:
                 step.proc.kill()  # crash simulation: the user workload
                                   # is deliberately orphaned
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
         if self._server is not None:
             self._server.stop(grace=0.5)
         if graceful:

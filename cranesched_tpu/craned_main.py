@@ -65,6 +65,9 @@ def main(argv=None) -> int:
     ap.add_argument("--log-file", default="",
                     help="rotating log file (32 MiB x 5 by default)")
     ap.add_argument("--log-level", default="info")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="Prometheus /metrics port (0 = ephemeral; "
+                         "unset = endpoint off)")
     args = ap.parse_args(argv)
     from cranesched_tpu.utils.logging import setup_logging
     setup_logging("craned", args.log_file, args.log_level)
@@ -106,10 +109,14 @@ def main(argv=None) -> int:
              if args.tls_ca else None),
         tls_name=args.tls_name,
         container_runtime=args.container_runtime,
-        pam_alias=True)
+        pam_alias=True,
+        metrics_port=args.metrics_port)
     port = daemon.start(args.listen)
     print(f"craned {args.name} serving on port {port}, "
           f"registering with {args.ctld}", flush=True)
+    if daemon.metrics_port is not None:
+        print(f"metrics: http://0.0.0.0:{daemon.metrics_port}/metrics",
+              flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -143,10 +143,14 @@ class RunLedger:
         np.add.at(out, self.node[mask], dcost)
         return out.astype(np.int32)
 
-    def timed_rows(self, now: float, resolution: float, T: int
+    def timed_rows(self, now: float, resolution: float, T: int,
+                   grid=None
                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(nodes[M,1], allocs[M,R], end_buckets[M]) for the backfill
-        grid; overdue rows release no earlier than bucket 1."""
+        grid; overdue rows release no earlier than bucket 1.  With a
+        TimeGrid the release bucket follows its (possibly geometric)
+        edges; the bare (resolution, T) path is the uniform special
+        case kept for existing callers."""
         mask = self.active
         M = int(mask.sum())
         if M == 0:
@@ -154,7 +158,10 @@ class RunLedger:
                     np.zeros((1, self._dims), np.int32),
                     np.full(1, T, np.int32))
         rem = self.remaining(now)[mask]
-        eb = np.maximum(np.ceil(rem / resolution), 1).astype(np.int32)
+        if grid is not None:
+            eb = np.minimum(grid.release_bucket(rem), T).astype(np.int32)
+        else:
+            eb = np.maximum(np.ceil(rem / resolution), 1).astype(np.int32)
         return (self.node[mask].astype(np.int32).reshape(-1, 1),
                 self.alloc[mask].astype(np.int32),
                 eb)

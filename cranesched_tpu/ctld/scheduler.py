@@ -62,11 +62,33 @@ from cranesched_tpu.models.solver import (
 )
 from cranesched_tpu.models.packing import PackedJobBatch, solve_packed
 from cranesched_tpu.models.solver_time import (
+    TimeGrid,
     TimedJobBatch,
     make_timed_state,
     solve_backfill,
 )
+from cranesched_tpu.obs import REGISTRY as _OBS
+from cranesched_tpu.obs.trace import CycleTraceRing, solve_span
 from cranesched_tpu.ops.resources import CPU_SCALE, DIM_CPU, DIM_MEM
+
+# cycle-plane metrics (naming: ARCHITECTURE.md "Observability")
+_MET_CYCLES = _OBS.counter(
+    "crane_cycles_total", "scheduling cycles completed")
+_MET_PHASE = _OBS.histogram(
+    "crane_cycle_phase_seconds",
+    "wall time per cycle phase (label phase=prelude|solve|commit)")
+_MET_LOCK = _OBS.histogram(
+    "crane_lock_held_seconds",
+    "server-lock-held time per cycle (prelude + commit, never solve)")
+_MET_SOLVE = _OBS.histogram(
+    "crane_solve_seconds",
+    "lock-released solve closure time (label backend)")
+_MET_STARTED = _OBS.counter(
+    "crane_jobs_started_total", "jobs started by the scheduler")
+_MET_PREEMPTED = _OBS.counter(
+    "crane_preempted_total", "running jobs evicted by preemption")
+_MET_PENDING = _OBS.gauge(
+    "crane_pending_jobs", "pending queue depth at cycle start")
 
 _REASON_MAP = {
     REASON_RESOURCE: PendingReason.RESOURCE,
@@ -95,6 +117,12 @@ class SchedulerConfig:
     backfill: bool = True
     time_resolution: float = 60.0       # seconds per bucket
     time_buckets: int = 64              # horizon = resolution * buckets
+    # optional geometric far horizon (TimeGrid, models/solver_time.py):
+    # None keeps the uniform resolution*buckets grid; a value larger
+    # than resolution*buckets stretches the tail buckets geometrically
+    # so e.g. 7-day jobs reserve at day scale instead of saturating the
+    # last uniform bucket (the 60x over-reservation fixed in round 6)
+    time_horizon: float | None = None
     # bounded backfill lookahead (the Slurm bf_max_job_test analog,
     # default 1000; the reference bounds the same scan with
     # ScheduledBatchSize): cycles larger than this run the timed solve
@@ -112,6 +140,9 @@ class SchedulerConfig:
     # config PreemptType/PreemptMode etc/config.yaml:280-290):
     # "off" | "requeue" | "cancel" — what happens to the victims
     preempt_mode: str = "off"
+    # bounded ring of structured per-cycle traces (obs/trace.py),
+    # queryable via QueryStats / `cstats --cycles`
+    cycle_trace_ring: int = 64
     # solver backend for immediate-fit cycles: "auto" prefers the native
     # C++ treap solver (bit-identical, ~fastest single-host) and falls
     # back to the device scan; "device" forces the JAX scan; "native"
@@ -220,6 +251,12 @@ class JobScheduler:
         # seed + backfill release rows come from O(rows) numpy instead
         # of an O(running) Python loop every cycle (VERDICT r2 weak #4)
         self._ledger = RunLedger(meta.layout.num_dims)
+        # one shared time axis for every duration-aware solve: batch
+        # time_limits stay in SECONDS and the solver derives occupancy
+        # windows from these edges (uniform when time_horizon is None)
+        self._grid = TimeGrid(config.time_buckets,
+                              config.time_resolution,
+                              horizon=config.time_horizon)
         # node lifecycle event seam (reference NodeEventHook,
         # Plugin.proto:75-95 — the plugin daemon's node-event surface):
         # callable(event_dict) fired on up/down/drain/undrain/power
@@ -233,8 +270,13 @@ class JobScheduler:
         self.stats = {
             "cycles": 0, "jobs_started_total": 0,
             "jobs_submitted_total": 0, "jobs_finished_total": 0,
-            "last_cycle": {},
+            "last_cycle": {}, "last_cycle_walltime": 0.0,
         }
+        # structured per-cycle traces (obs/trace.py); _cur_trace is the
+        # in-flight cycle's mutable accumulator — cycles are serialized
+        # by the server lock, so one slot suffices
+        self.cycle_trace = CycleTraceRing(config.cycle_trace_ring)
+        self._cur_trace: dict = {}
         if archive is not None:
             self.attach_archive(archive)
 
@@ -1370,6 +1412,12 @@ class JobScheduler:
         authoritative ledger per job."""
         import time as _time
         t0 = _time.perf_counter()
+        self._cur_trace = {
+            "now": now, "queue_depth": len(self.pending),
+            "solver": "", "solve_ms": 0.0,
+            "preempted": 0, "backfilled": 0,
+        }
+        _MET_PENDING.set(len(self.pending))
         self.process_status_changes()
         self._check_craned_timeouts(now)
         self._check_alloc_timeouts(now)
@@ -1379,8 +1427,14 @@ class JobScheduler:
         t_prelude = _time.perf_counter()
 
         self.stats["cycles"] += 1
+        _MET_CYCLES.inc()
         candidates = self._pending_candidates(now)
         if not candidates:
+            # empty cycles still refresh the liveness timestamp (the
+            # watchdog's stall detection keys off it) but don't enter
+            # the trace ring — an idle cluster would otherwise flush
+            # every interesting trace out of the ring
+            self.stats["last_cycle_walltime"] = _time.time()
             self.stats["last_cycle"] = {
                 "prelude_ms": round((t_prelude - t0) * 1e3, 3),
                 "pending": 0, "started": 0,
@@ -1419,8 +1473,9 @@ class JobScheduler:
         if packed:
             state = make_cluster_state(avail, total, alive, cost0)
             pbatch = self._packed_batch(jobs_batch, ordered)
-            placements = yield (lambda: solve_packed(
-                state, pbatch, max_nodes=max_nodes)[0])
+            placements = yield self._traced_solve(
+                "packed", lambda: solve_packed(
+                    state, pbatch, max_nodes=max_nodes)[0])
             started = self._commit(ordered, placements, now,
                                    tasks=np.asarray(placements.tasks))
             started += self._try_preemption(ordered, now)
@@ -1442,12 +1497,16 @@ class JobScheduler:
                 return started
             state = self._timed_state(now, avail, total, alive, cost0)
             tbatch = self._timed_batch(jobs_batch, ordered)
-            placements = yield (lambda: solve_backfill(
-                state, tbatch, max_nodes=max_nodes)[0])
+            placements = yield self._traced_solve(
+                "backfill", lambda: solve_backfill(
+                    state, tbatch, edges=self._grid.jnp_edges,
+                    max_nodes=max_nodes)[0])
             start_buckets = np.asarray(placements.start_bucket)
+            self._cur_trace["backfilled"] = int(np.sum(
+                np.asarray(placements.placed) & (start_buckets > 0)))
         else:
-            placements, solver_name = yield (
-                lambda: self._immediate_solve(
+            placements, solver_name = yield self._traced_solve(
+                None, lambda: self._immediate_solve(
                     avail, total, alive, cost0, jobs_batch, max_nodes))
             start_buckets = None
 
@@ -1516,10 +1575,14 @@ class JobScheduler:
 
         state = self._timed_state(now, avail, total, alive, cost0)
         tb = self._timed_batch(head_batch, head)
-        placements, tstate = yield (
-            lambda: solve_backfill(state, tb, max_nodes=max_nodes))
-        started = self._commit(head, placements, now,
-                               np.asarray(placements.start_bucket))
+        placements, tstate = yield self._traced_solve(
+            "backfill", lambda: solve_backfill(
+                state, tb, edges=self._grid.jnp_edges,
+                max_nodes=max_nodes))
+        head_start = np.asarray(placements.start_bucket)
+        self._cur_trace["backfilled"] = int(np.sum(
+            np.asarray(placements.placed) & (head_start > 0)))
+        started = self._commit(head, placements, now, head_start)
 
         # pass 2: the tail against the tightest bucket of the horizon
         self.meta.start_logging()   # fresh event window for this commit
@@ -1530,7 +1593,7 @@ class JobScheduler:
             return self._immediate_solve(
                 min_avail, total, alive, cost1, tail_batch, max_nodes)
 
-        placements2, _ = yield _tail_solve
+        placements2, _ = yield self._traced_solve(None, _tail_solve)
         tail_placements = Placements(
             placed=placements2.placed[bf_max:],
             nodes=placements2.nodes[bf_max:],
@@ -1538,18 +1601,70 @@ class JobScheduler:
         started += self._commit(tail, tail_placements, now)
         return started
 
+    def _traced_solve(self, backend, fn):
+        """Wrap a yielded solve closure: time it (this is the
+        lock-RELEASED span), tag it with a jax.profiler span so device
+        traces line up with cycle phases, and record backend + latency
+        into the in-flight cycle trace.  ``backend=None`` derives the
+        label from an ``(placements, solver_name)`` result tuple
+        (the _immediate_solve contract)."""
+        import time as _time
+        trace = self._cur_trace
+
+        def run():
+            label = backend or "immediate"
+            t0 = _time.perf_counter()
+            with solve_span(f"crane:solve:{label}"):
+                out = fn()
+            dt = _time.perf_counter() - t0
+            if (backend is None and isinstance(out, tuple)
+                    and len(out) == 2 and isinstance(out[1], str)):
+                label = out[1]
+            trace["solve_ms"] = trace.get("solve_ms", 0.0) + dt * 1e3
+            if not trace.get("solver"):
+                trace["solver"] = label
+            _MET_SOLVE.observe(dt, backend=label)
+            return out
+
+        return run
+
     def _record_cycle_stats(self, t0, t_prelude, candidates, started,
                             t_end, solver: str) -> None:
+        import time as _time
         self.stats["jobs_started_total"] += len(started)
+        _MET_STARTED.inc(len(started))
+        total_ms = (t_end - t0) * 1e3
+        prelude_ms = (t_prelude - t0) * 1e3
+        solve_ms = float(self._cur_trace.get("solve_ms", 0.0))
+        # commit = everything after the prelude that ran under the
+        # lock, i.e. total minus prelude minus the lock-released solves
+        commit_ms = max(total_ms - prelude_ms - solve_ms, 0.0)
         self.stats["last_cycle"] = {
             "solver": solver,
-            "prelude_ms": round((t_prelude - t0) * 1e3, 3),
+            "prelude_ms": round(prelude_ms, 3),
             "solve_commit_ms": round((t_end - t_prelude) * 1e3, 3),
-            "total_ms": round((t_end - t0) * 1e3, 3),
+            "total_ms": round(total_ms, 3),
             "pending": len(candidates),
             "started": len(started),
             "running": len(self.running),
         }
+        self.stats["last_cycle_walltime"] = _time.time()
+        trace = self._cur_trace
+        trace.update(
+            solver=solver,
+            prelude_ms=round(prelude_ms, 3),
+            solve_ms=round(solve_ms, 3),
+            commit_ms=round(commit_ms, 3),
+            total_ms=round(total_ms, 3),
+            lock_held_ms=round(prelude_ms + commit_ms, 3),
+            candidates=len(candidates),
+            placed=len(started),
+        )
+        self.cycle_trace.push(trace)
+        _MET_PHASE.observe(prelude_ms / 1e3, phase="prelude")
+        _MET_PHASE.observe(solve_ms / 1e3, phase="solve")
+        _MET_PHASE.observe(commit_ms / 1e3, phase="commit")
+        _MET_LOCK.observe((prelude_ms + commit_ms) / 1e3)
 
     def _solve_native(self, avail, total, alive, cost0, jobs_batch,
                       max_nodes):
@@ -1658,8 +1773,8 @@ class JobScheduler:
         T = self.config.time_buckets
         # one release row per (job, node) straight from the incremental
         # ledger — O(rows) numpy, no Python loop over running jobs
-        run_nodes, run_req, run_end = self._ledger.timed_rows(now, res,
-                                                              T)
+        run_nodes, run_req, run_end = self._ledger.timed_rows(
+            now, res, T, grid=self._grid)
         return make_timed_state(avail, total, alive, run_nodes, run_req,
                                 run_end, T, cost0)
 
@@ -1693,15 +1808,10 @@ class JobScheduler:
 
     def _timed_batch(self, batch: JobBatch, ordered: list[Job]
                      ) -> TimedJobBatch:
-        res = self.config.time_resolution
-        T = self.config.time_buckets
-        # derive durations from the batch itself so they cannot diverge
-        # from time_limit (padding rows clip to 1 bucket, still invalid)
-        dur = np.clip(np.ceil(np.asarray(batch.time_limit) / res),
-                      1, T).astype(np.int32)
+        # time_limit stays in seconds; the solver derives occupancy
+        # windows from the grid edges passed alongside the batch
         return TimedJobBatch(req=batch.req, node_num=batch.node_num,
                              time_limit=batch.time_limit,
-                             dur_buckets=jnp.asarray(dur),
                              part_mask=batch.part_mask, valid=batch.valid)
 
     # ------------------------------------------------------------------
@@ -1896,28 +2006,27 @@ class JobScheduler:
                 TimedPreemptorBatch, TimedVictimRows,
                 solve_preempt_timed)
 
-            res = self.config.time_resolution
             T = self.config.time_buckets
             r_end = np.full(M, T + 1, np.int32)
             for i, (vi, _n, _a) in enumerate(rows):
                 v = victims[vi]
                 remain = max((v.start_time or now)
                              + v.spec.time_limit - now, 0.0)
-                r_end[i] = min(int(np.ceil(remain / res)), T + 1)
+                r_end[i] = min(int(self._grid.release_bucket(remain)),
+                               T + 1)
             tstate = self._timed_state(now, avail, total, alive,
                                        self._ledger.cost0(now, N))
             tbatch = TimedPreemptorBatch(
                 req=batch.req, node_num=batch.node_num,
                 time_limit=batch.time_limit,
-                dur_buckets=jnp.asarray(np.clip(
-                    np.ceil(time_limit / res), 1, T).astype(np.int32)),
                 part_mask=batch.part_mask, exclusive=batch.exclusive,
                 can_prey=batch.can_prey, valid=batch.valid)
             decisions, _ = solve_preempt_timed(
                 tstate.time_avail, total, alive, tstate.cost,
                 TimedVictimRows(rows=vrows,
                                 end_bucket=jnp.asarray(r_end)),
-                tbatch, num_victims=V, max_nodes=max_nodes)
+                tbatch, num_victims=V, max_nodes=max_nodes,
+                edges=self._grid.jnp_edges)
             start_buckets = np.asarray(decisions.start_bucket)
         else:
             decisions, _ = solve_preempt(
@@ -2007,6 +2116,9 @@ class JobScheduler:
         victim = self.running.get(victim_id)
         if victim is None:
             return
+        _MET_PREEMPTED.inc()
+        self._cur_trace["preempted"] = (
+            self._cur_trace.get("preempted", 0) + 1)
         if victim.spec.alloc_only:
             self.dispatch_free_alloc(victim_id, now,
                                      incarnation=victim.requeue_count)

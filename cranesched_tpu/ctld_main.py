@@ -43,6 +43,9 @@ def main(argv=None) -> int:
     ap.add_argument("--listen", default="",
                     help="override the config listen address")
     ap.add_argument("--cycle-interval", type=float, default=1.0)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="Prometheus /metrics port (overrides config "
+                         "Observability.MetricsPort; 0 = ephemeral)")
     ap.add_argument("--log-file", default="",
                     help="rotating log file (32 MiB x 5 by default)")
     ap.add_argument("--log-level", default="info")
@@ -117,14 +120,20 @@ def main(argv=None) -> int:
         print(f"auth enabled (token table {cfg.auth_token_file}; "
               f"root + craned tokens inside)", flush=True)
 
+    metrics_port = (args.metrics_port if args.metrics_port is not None
+                    else cfg.metrics_port)
     address = args.listen or cfg.listen
     server, port = serve(scheduler, sim=sim, address=address,
                          cycle_interval=args.cycle_interval,
-                         dispatcher=dispatcher, auth=auth, tls=tls)
+                         dispatcher=dispatcher, auth=auth, tls=tls,
+                         metrics_port=metrics_port)
     print(f"cranectld [{cfg.cluster_name}] listening on port {port} "
           f"({'simulated' if args.sim else 'real'} node plane, "
           f"{len(meta.nodes)} nodes configured"
           f"{', TLS' if tls else ''})", flush=True)
+    if server.metrics_port is not None:
+        print(f"metrics: http://0.0.0.0:{server.metrics_port}/metrics",
+              flush=True)
 
     syncer = None
     if cfg.license_sync.get("Program"):

@@ -271,7 +271,9 @@ def _job_scalars(req, node_num, time_limit, valid, job_class, C):
 def _launch(job_p, nelig, avail3, cost2, elig3, cputot3,
             S, NB, BJ, K, R, W, C, interpret):
     """pallas_call plumbing shared by both entry points.  job_p is
-    [S, NB*BJ, R+4]; returns raw blocked outputs + final ledgers."""
+    [S, R+4, NB*BJ] (scalar axis innermost so the SMEM BlockSpec
+    (S, R+4, BJ) slices the job axis per grid step); returns raw
+    blocked outputs + final ledgers."""
     def vmem_full():
         return pl.BlockSpec(memory_space=pltpu.VMEM)
 

@@ -1,0 +1,25 @@
+"""Observability layer: dependency-free metrics, cycle tracing, and the
+scheduler watchdog (round 6).
+
+- ``metrics.py``  process-wide registry of counters / gauges /
+                  histograms with Prometheus text exposition and a
+                  stdlib HTTP endpoint (no prometheus_client dep).
+- ``trace.py``    bounded ring of structured per-cycle traces plus the
+                  jax.profiler span helper used around solve closures.
+
+See ARCHITECTURE.md ("Observability") for the metric naming scheme and
+the cycle-trace schema.
+"""
+
+from cranesched_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    serve_metrics,
+)
+from cranesched_tpu.obs.trace import (  # noqa: F401
+    CycleTraceRing,
+    solve_span,
+)

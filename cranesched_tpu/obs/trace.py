@@ -1,0 +1,71 @@
+"""Structured per-cycle traces + profiler span helper.
+
+``CycleTraceRing`` keeps the last N cycle traces (plain dicts, schema
+below) in a bounded deque — cheap enough to run always-on, queryable
+over RPC through QueryStats (``cstats --cycles`` renders it).
+
+Cycle-trace schema (ARCHITECTURE.md "Observability"):
+
+    now              float   scheduler clock the cycle ran at
+    solver           str     backend ("native", "pallas", "backfill"...)
+    prelude_ms       float   lock-held bookkeeping before the solve
+    solve_ms         float   lock-RELEASED time in yielded closures
+    commit_ms        float   lock-held time after the first solve
+    total_ms         float   wall time of the whole cycle
+    lock_held_ms     float   prelude_ms + commit_ms (never the solve)
+    candidates       int     jobs considered this cycle
+    placed           int     jobs started (incl. backfill tail)
+    preempted        int     victims killed by this cycle
+    backfilled       int     placed with start_bucket > 0 (future start)
+    queue_depth      int     pending queue size at cycle start
+
+``solve_span`` wraps a solve closure in ``jax.profiler.TraceAnnotation``
+so tools/kexp.py traces line up with cycle phases; it degrades to a
+no-op when the profiler is unavailable (CPU CI containers).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+from typing import Iterator
+
+
+class CycleTraceRing:
+    """Thread-safe bounded ring of per-cycle trace dicts."""
+
+    def __init__(self, size: int = 64):
+        self._ring = collections.deque(maxlen=max(int(size), 1))
+        self._lock = threading.Lock()
+
+    def push(self, trace: dict) -> None:
+        with self._lock:
+            self._ring.append(trace)
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        """Newest-last copy of the ring (optionally only the last N)."""
+        with self._lock:
+            out = list(self._ring)
+        return out if last is None else out[-last:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+@contextlib.contextmanager
+def solve_span(name: str) -> Iterator[None]:
+    """jax.profiler.TraceAnnotation span, no-op without a profiler.
+
+    Used around the lock-released solve closures so a captured device
+    trace (KEXP_TRACE / jax.profiler.trace) shows one named span per
+    cycle phase — kernel attribution in tools/kexp.py then lines up
+    with the cycle trace timings."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:       # pragma: no cover - jax always importable here
+        yield
+        return
+    with TraceAnnotation(name):
+        yield

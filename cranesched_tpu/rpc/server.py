@@ -59,7 +59,8 @@ class CtldServer:
     def __init__(self, scheduler: JobScheduler,
                  sim: SimCluster | None = None,
                  cycle_interval: float = 1.0, tick_mode: bool = False,
-                 dispatcher=None, auth=None, tls=None):
+                 dispatcher=None, auth=None, tls=None,
+                 metrics_port: int | None = None):
         self.scheduler = scheduler
         self.sim = sim
         # real node plane: per-node push stubs (wired into the
@@ -76,6 +77,11 @@ class CtldServer:
         self.tls = tls
         self.cycle_interval = cycle_interval
         self.tick_mode = tick_mode
+        # Prometheus /metrics endpoint: None = off, 0 = ephemeral port
+        # (tests); the bound port lands in self.metrics_port after
+        # start()
+        self.metrics_port = metrics_port
+        self._metrics_server = None
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._cycle_thread: threading.Thread | None = None
@@ -516,6 +522,8 @@ class CtldServer:
     def QueryStats(self, request, context):
         self._require_authenticated(self._ident(context), context)
         import json as _json
+
+        from cranesched_tpu.obs import REGISTRY
         with self._lock:
             doc = dict(self.scheduler.stats)
             doc["licenses"] = {
@@ -524,6 +532,21 @@ class CtldServer:
                        "free": lic.free, "remote": lic.remote}
                 for name, lic in
                 self.scheduler.licenses.licenses.items()}
+            # obs layer: full metric snapshot + the cycle-trace ring +
+            # liveness, so `cstats --metrics/--cycles` needs no extra
+            # RPC and can flag "scheduler stalled" client-side
+            doc["metrics"] = REGISTRY.snapshot()
+            doc["cycle_trace"] = self.scheduler.cycle_trace.snapshot()
+            doc["watchdog"] = {
+                "now": time.time(),
+                "cycle_interval": self.cycle_interval,
+                "tick_mode": self.tick_mode,
+                "last_cycle_walltime":
+                    self.scheduler.stats.get("last_cycle_walltime", 0.0),
+                "cycle_crashes_total":
+                    self.scheduler.stats.get("cycle_crashes_total", 0),
+                "last_crash": self.scheduler.stats.get("last_crash"),
+            }
             return pb.StatsReply(json=_json.dumps(doc))
 
     def AcctMgr(self, request, context):
@@ -820,8 +843,10 @@ class CtldServer:
                 request_deserializer=pb.QueryJobsRequest.FromString,
                 response_serializer=(
                     pb.QueryJobsReply.SerializeToString))
+        from cranesched_tpu.rpc.interceptors import MetricsInterceptor
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8))
+            futures.ThreadPoolExecutor(max_workers=8),
+            interceptors=(MetricsInterceptor(plane="ctld"),))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
         if self.tls is not None:
@@ -831,6 +856,10 @@ class CtldServer:
         else:
             port = self._server.add_insecure_port(address)
         self._server.start()
+        if self.metrics_port is not None:
+            from cranesched_tpu.obs import serve_metrics
+            self._metrics_server = serve_metrics(self.metrics_port)
+            self.metrics_port = self._metrics_server.server_address[1]
         if not self.tick_mode:
             self._cycle_thread = threading.Thread(
                 target=self._cycle_loop, daemon=True)
@@ -847,9 +876,27 @@ class CtldServer:
         queries landing mid-cycle wait microseconds, not a full solve
         (reference: 9 scheduler threads + per-entry-locked maps,
         JobScheduler.h:1290-1335; here one cycle thread + a lock whose
-        hold time excludes the solve)."""
+        hold time excludes the solve).
+
+        WATCHDOG: any exception escaping a cycle — prelude, solve
+        closure, or commit — used to kill this thread and silently stop
+        scheduling forever.  Now each iteration is fenced: the
+        traceback is logged and kept in stats["last_crash"],
+        crane_cycle_crashes_total is bumped, the half-run generator is
+        closed, and the NEXT tick schedules normally (fault-injection
+        test: tests/test_obs.py)."""
         while not self._stop.wait(self.cycle_interval):
             now = time.time()
+            try:
+                self._cycle_once(now)
+            except Exception:
+                self._record_cycle_crash(now)
+
+    def _cycle_once(self, now: float) -> None:
+        """One lock-break cycle: state phases under the lock, solve
+        closures outside it."""
+        gen = None
+        try:
             with self._lock:
                 if self.sim is not None:
                     self.sim.advance_to(now)
@@ -857,17 +904,45 @@ class CtldServer:
                 try:
                     fn = next(gen)
                 except StopIteration:
-                    continue
+                    return
             while True:
                 result = fn()          # lock released: the solve
                 with self._lock:
                     try:
                         fn = gen.send(result)
                     except StopIteration:
-                        break
+                        return
+        except Exception:
+            if gen is not None:
+                with self._lock:
+                    try:
+                        gen.close()    # unwind the half-run cycle
+                    except Exception:
+                        pass
+            raise
+
+    def _record_cycle_crash(self, now: float) -> None:
+        import logging
+        import traceback
+
+        from cranesched_tpu.obs import REGISTRY
+        tb = traceback.format_exc()
+        logging.getLogger("cranesched.ctld").error(
+            "scheduling cycle crashed (next tick continues):\n%s", tb)
+        REGISTRY.counter(
+            "crane_cycle_crashes_total",
+            "scheduling cycles that died with an exception").inc()
+        with self._lock:
+            st = self.scheduler.stats
+            st["cycle_crashes_total"] = (
+                st.get("cycle_crashes_total", 0) + 1)
+            st["last_crash"] = {"time": now, "traceback": tb}
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
         if self._server is not None:
             self._server.stop(grace=0.5)
 

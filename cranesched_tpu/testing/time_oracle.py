@@ -39,8 +39,7 @@ def build_time_avail_oracle(avail, run_nodes, run_req, run_end_bucket,
 
 
 def solve_backfill_oracle(time_avail, total, alive, cost, req, node_num,
-                          time_limit, dur_buckets, part_mask, valid,
-                          max_nodes):
+                          time_limit, part_mask, valid, max_nodes):
     """Same contract as models.solver_time.solve_backfill, in loops.
 
     Returns (placed[J], start[J], nodes[J, max_nodes], reason[J],
@@ -66,7 +65,9 @@ def solve_backfill_oracle(time_avail, total, alive, cost, req, node_num,
                          else REASON_RESOURCE)
             continue
         eligible = alive & part_mask[j]
-        d = int(dur_buckets[j])
+        # unit grid (1 bucket == 1 second): duration in buckets is the
+        # time_limit itself, floored to one bucket like the solver
+        d = max(int(time_limit[j]), 1)
 
         # ok[n, s]: node n fits req for every bucket in [s, min(s+d, T))
         ok = np.zeros((N, T), bool)

@@ -102,6 +102,15 @@ class CraneConfig:
     # LicenseManager.h:46-125): LicenseSync: {Program, Interval}
     license_sync: dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # observability (obs/): Observability: {MetricsPort, CycleTraceRing}
+    # — MetricsPort absent/None = no /metrics endpoint, 0 = ephemeral
+    observability: dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def metrics_port(self) -> int | None:
+        port = self.observability.get("MetricsPort")
+        return None if port is None else int(port)
 
     def tls_config(self):
         """-> utils.pki.TlsConfig for the ctld server, or None."""
@@ -167,6 +176,10 @@ class CraneConfig:
             backfill=bool(sc.get("Backfill", True)),
             time_resolution=float(sc.get("TimeResolutionSec", 60)),
             time_buckets=int(sc.get("TimeBuckets", 64)),
+            time_horizon=(float(sc["TimeHorizonSec"])
+                          if sc.get("TimeHorizonSec") else None),
+            cycle_trace_ring=int(
+                self.observability.get("CycleTraceRing", 64)),
             craned_timeout=float(sc.get("CranedTimeoutSec", 30)),
             preempt_mode=str(sc.get("PreemptMode", "off")).lower(),
             solver=str(sc.get("Solver", "auto")).lower())
@@ -298,4 +311,5 @@ def load_config(path: str) -> CraneConfig:
                      (raw.get("Auth") or {}).get("Admins", ["root"])],
         node_event_hook_path=str(raw.get("NodeEventHook", "") or ""),
         tls=raw.get("Tls", {}) or {},
-        license_sync=raw.get("LicenseSync", {}) or {})
+        license_sync=raw.get("LicenseSync", {}) or {},
+        observability=raw.get("Observability", {}) or {})

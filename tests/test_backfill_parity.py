@@ -51,26 +51,26 @@ def make_jobs(reqs, node_nums, durs, part_mask=None, valid=None,
     J = len(reqs)
     req = np.stack(reqs).astype(np.int32)
     nn = np.asarray(node_nums, np.int32)
-    db = np.asarray(durs, np.int32)
+    # unit grid (edges=None): 1 bucket == 1 second, so time_limit IS the
+    # duration in buckets — the solver derives the window from it
     tl = (np.asarray(time_limits, np.int32) if time_limits is not None
-          else db * 60)
+          else np.asarray(durs, np.int32))
     pm = (np.ones((J, num_nodes), bool) if part_mask is None
           else np.asarray(part_mask))
     v = np.ones(J, bool) if valid is None else np.asarray(valid)
     return TimedJobBatch(req=jnp.asarray(req), node_num=jnp.asarray(nn),
                          time_limit=jnp.asarray(tl),
-                         dur_buckets=jnp.asarray(db),
                          part_mask=jnp.asarray(pm),
-                         valid=jnp.asarray(v)), (req, nn, tl, db, pm, v)
+                         valid=jnp.asarray(v)), (req, nn, tl, pm, v)
 
 
 def assert_parity(state, oracle_ta, alive, cost, jobs, cols, max_nodes):
-    req, nn, tl, db, pm, v = cols
+    req, nn, tl, pm, v = cols
     placements, new_state = solve_backfill(state, jobs,
                                            max_nodes=max_nodes)
     o_placed, o_start, o_nodes, o_reason, o_ta, o_cost = \
         solve_backfill_oracle(oracle_ta, np.asarray(state.total), alive,
-                              cost, req, nn, tl, db, pm, v, max_nodes)
+                              cost, req, nn, tl, pm, v, max_nodes)
     np.testing.assert_array_equal(np.asarray(placements.placed), o_placed)
     got_start = np.asarray(placements.start_bucket)
     np.testing.assert_array_equal(np.where(o_placed, got_start, 0),

@@ -1,0 +1,345 @@
+"""Observability layer (obs/): metrics registry + Prometheus
+exposition, the /metrics HTTP endpoint on a live ctld, cycle tracing
+through real scheduling cycles, RPC-plane instrumentation, and the
+cycle watchdog's fault-injection acceptance test."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.obs import CycleTraceRing, REGISTRY
+from cranesched_tpu.obs.metrics import MetricsRegistry, serve_metrics
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.client import CtldClient
+from cranesched_tpu.rpc.server import serve
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------- registry unit behavior ----------------
+
+def test_counter_gauge_histogram_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("crane_t_total", "things")
+    c.inc()
+    c.inc(2, kind="a")
+    g = reg.gauge("crane_t_state", "a state")
+    g.set(2, node="cn0")
+    h = reg.histogram("crane_t_seconds", "latency")
+    h.observe(0.002)
+    h.observe(50.0)
+    h.observe(1e9)   # beyond the largest finite bucket -> +Inf only
+    text = reg.expose()
+    assert "# TYPE crane_t_total counter" in text
+    assert "crane_t_total 1" in text
+    assert 'crane_t_total{kind="a"} 2' in text
+    assert 'crane_t_state{node="cn0"} 2' in text
+    assert "# TYPE crane_t_seconds histogram" in text
+    assert 'crane_t_seconds_bucket{le="+Inf"} 3' in text
+    assert "crane_t_seconds_count 3" in text
+    # cumulative bucket counts are monotone
+    counts = [int(line.rsplit(" ", 1)[1])
+              for line in text.splitlines()
+              if line.startswith("crane_t_seconds_bucket")]
+    assert counts == sorted(counts)
+
+
+def test_registry_idempotent_and_type_checked():
+    reg = MetricsRegistry()
+    assert reg.counter("crane_x_total") is reg.counter("crane_x_total")
+    with pytest.raises(TypeError):
+        reg.gauge("crane_x_total")
+
+
+def test_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("crane_a_total", "a").inc(3)
+    reg.histogram("crane_b_seconds", "b").observe(0.5, phase="solve")
+    snap = reg.snapshot()
+    assert snap["crane_a_total"]["values"][""] == 3
+    (labels, series), = snap["crane_b_seconds"]["values"].items()
+    assert "solve" in labels and series["count"] == 1
+
+
+def test_trace_ring_bounded():
+    ring = CycleTraceRing(4)
+    for i in range(10):
+        ring.push({"now": i})
+    got = [t["now"] for t in ring.snapshot()]
+    assert got == [6, 7, 8, 9]
+    assert [t["now"] for t in ring.snapshot(last=2)] == [8, 9]
+
+
+def test_standalone_metrics_http_endpoint():
+    reg = MetricsRegistry()
+    reg.counter("crane_http_total", "t").inc(7)
+    srv = serve_metrics(0, host="127.0.0.1", registry=reg)
+    try:
+        port = srv.server_address[1]
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "crane_http_total 7" in txt
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5)
+    finally:
+        srv.shutdown()
+
+
+# ---------------- live-cluster plumbing ----------------
+
+def _cluster(num_nodes=4, backfill=False):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(
+            f"cn{i:02d}",
+            meta.layout.encode(cpu=16, mem_bytes=32 << 30,
+                               memsw_bytes=32 << 30, is_capacity=True),
+            partitions=("default",))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=backfill))
+    cluster = SimCluster(sched)
+    cluster.wire(sched)
+    return meta, sched, cluster
+
+
+def _pbspec(cpu=1.0, runtime=30.0):
+    return pb.JobSpec(
+        res=pb.ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                            memsw_bytes=1 << 30),
+        time_limit=3600, partition="default", user="alice",
+        sim_runtime=runtime)
+
+
+def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_ctld_metrics_endpoint_and_query_stats():
+    """Acceptance: GET /metrics on a live ctld serves Prometheus text
+    with the cycle-phase, lock-held, per-backend solve, and per-RPC
+    latency series; QueryStats carries the same registry snapshot plus
+    the cycle-trace ring."""
+    meta, sched, cluster = _cluster()
+    server, port = serve(sched, sim=cluster, address="127.0.0.1:0",
+                         cycle_interval=0.05, metrics_port=0)
+    client = CtldClient(f"127.0.0.1:{port}")
+    try:
+        for _ in range(3):
+            client.submit(_pbspec())
+        assert _wait(lambda: sched.stats["jobs_started_total"] >= 3)
+        assert _wait(lambda: len(sched.cycle_trace) > 0)
+
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.metrics_port}/metrics",
+            timeout=5).read().decode()
+        for phase in ("prelude", "solve", "commit"):
+            assert (f'crane_cycle_phase_seconds_bucket{{phase="{phase}"'
+                    in txt), f"missing phase={phase} in:\n{txt[:2000]}"
+        assert "crane_lock_held_seconds_bucket" in txt
+        assert 'crane_solve_seconds_bucket{backend="' in txt
+        assert ('crane_rpc_latency_seconds_bucket'
+                '{method="SubmitBatchJob"') in txt
+        assert "crane_rpc_requests_total" in txt
+        assert "crane_cycles_total" in txt
+
+        doc = json.loads(client.query_stats().json)
+        assert doc["metrics"]["crane_cycles_total"]["values"]
+        trace = doc["cycle_trace"][-1]
+        for field in ("now", "solver", "prelude_ms", "solve_ms",
+                      "commit_ms", "total_ms", "lock_held_ms",
+                      "candidates", "placed", "queue_depth",
+                      "preempted", "backfilled"):
+            assert field in trace, f"trace missing {field}: {trace}"
+        assert trace["solver"]
+        assert doc["watchdog"]["last_cycle_walltime"] > 0
+    finally:
+        server.stop()
+
+
+def test_cycle_trace_solve_time_excluded_from_lock_held():
+    """The trace must attribute a slow solve to solve_ms, not to the
+    lock-held phases — the whole point of the lock break."""
+    meta, sched, cluster = _cluster()
+    inner = sched._immediate_solve
+
+    def slow(*a, **kw):
+        time.sleep(0.2)
+        return inner(*a, **kw)
+
+    sched._immediate_solve = slow
+    sched.submit(JobSpec(res=ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                                          memsw_bytes=1 << 30),
+                         sim_runtime=30.0), now=0.0)
+    started = sched.schedule_cycle(now=1.0)
+    assert len(started) == 1
+    trace = sched.cycle_trace.snapshot()[-1]
+    assert trace["solve_ms"] >= 200.0
+    assert trace["lock_held_ms"] < 150.0
+    assert trace["placed"] == 1
+    assert trace["candidates"] == 1
+
+
+def test_cstats_cli_cycles_and_metrics(capsys):
+    from cranesched_tpu.cli import main as cli_main
+    meta, sched, cluster = _cluster()
+    server, port = serve(sched, sim=cluster, address="127.0.0.1:0",
+                         cycle_interval=0.05)
+    try:
+        client = CtldClient(f"127.0.0.1:{port}")
+        client.submit(_pbspec())
+        assert _wait(lambda: len(sched.cycle_trace) > 0)
+        assert cli_main(["--server", f"127.0.0.1:{port}",
+                         "cstats", "--cycles"]) == 0
+        out = capsys.readouterr().out
+        assert "SOLVER" in out and "LOCK_MS" in out
+        assert cli_main(["--server", f"127.0.0.1:{port}",
+                         "cstats", "--metrics"]) == 0
+        out = capsys.readouterr().out
+        assert "crane_cycles_total" in out
+    finally:
+        server.stop()
+
+
+# ---------------- the watchdog ----------------
+
+def test_cycle_crash_watchdog_fault_injection():
+    """Acceptance: one cycle raises inside the solve closure ->
+    crane_cycle_crashes_total increments, the traceback lands in
+    stats["last_crash"], and the very NEXT tick schedules jobs
+    normally (the cycle thread survives)."""
+    meta, sched, cluster = _cluster()
+    inner = sched._immediate_solve
+    state = {"armed": False, "crashes": 0}
+
+    def maybe_crash(*a, **kw):
+        if state["armed"]:
+            state["armed"] = False
+            state["crashes"] += 1
+            raise RuntimeError("injected solver fault")
+        return inner(*a, **kw)
+
+    sched._immediate_solve = maybe_crash
+    crashes0 = REGISTRY.counter("crane_cycle_crashes_total").value()
+    server, port = serve(sched, sim=cluster, address="127.0.0.1:0",
+                         cycle_interval=0.05)
+    client = CtldClient(f"127.0.0.1:{port}")
+    try:
+        # healthy baseline
+        client.submit(_pbspec())
+        assert _wait(lambda: sched.stats["jobs_started_total"] >= 1)
+
+        # arm the fault, then submit: the next solving cycle dies
+        state["armed"] = True
+        client.submit(_pbspec())
+        assert _wait(lambda: state["crashes"] == 1)
+        assert _wait(lambda: sched.stats.get("cycle_crashes_total", 0)
+                     >= 1)
+        # the job from the crashed cycle is scheduled by a LATER tick —
+        # the thread must still be alive
+        assert _wait(lambda: sched.stats["jobs_started_total"] >= 2), \
+            "cycle thread died: next tick never scheduled"
+
+        doc = json.loads(client.query_stats().json)
+        assert doc["cycle_crashes_total"] >= 1
+        assert "injected solver fault" in \
+            doc["last_crash"]["traceback"]
+        assert doc["watchdog"]["cycle_crashes_total"] >= 1
+        assert REGISTRY.counter(
+            "crane_cycle_crashes_total").value() >= crashes0 + 1
+
+        # and the cluster still takes + runs NEW work after the crash
+        client.submit(_pbspec())
+        assert _wait(lambda: sched.stats["jobs_started_total"] >= 3)
+    finally:
+        server.stop()
+
+
+def test_crash_in_locked_phase_also_survives():
+    """A crash in the prelude (under the lock, before any solve) must
+    not kill the loop either — the watchdog closes the half-run
+    generator and the next tick runs clean."""
+    meta, sched, cluster = _cluster()
+    inner = sched.process_status_changes
+    state = {"armed": True}
+
+    def crash_once():
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("prelude fault")
+        return inner()
+
+    sched.process_status_changes = crash_once
+    server, port = serve(sched, sim=cluster, address="127.0.0.1:0",
+                         cycle_interval=0.05)
+    client = CtldClient(f"127.0.0.1:{port}")
+    try:
+        assert _wait(lambda: sched.stats.get("cycle_crashes_total", 0)
+                     >= 1)
+        client.submit(_pbspec())
+        assert _wait(lambda: sched.stats["jobs_started_total"] >= 1)
+    finally:
+        server.stop()
+
+
+def test_craned_daemon_metrics(tmp_path):
+    """Craned plane: FSM state gauge + register/ping RTT + spawn and
+    cgroup timings flow into the shared registry, served from the
+    daemon's own /metrics endpoint."""
+    from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+    from cranesched_tpu.ctld import JobStatus
+    from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, address="127.0.0.1:0",
+                         cycle_interval=0.15, dispatcher=dispatcher)
+    daemon = CranedDaemon(
+        "obs0", f"127.0.0.1:{port}", cpu=2.0, mem_bytes=4 << 30,
+        workdir=str(tmp_path), ping_interval=0.5,
+        cgroup_root=str(tmp_path / "nocgroup"), metrics_port=0)
+    try:
+        daemon.start()
+        assert _wait(lambda: daemon.state == CranedState.READY)
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics",
+            timeout=5).read().decode()
+        assert 'crane_craned_state{node="obs0"} 2' in txt
+        assert 'crane_craned_ctld_seconds_bucket{op="register"' in txt
+
+        # run one real step end to end: spawn + cgroup series appear
+        jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                                   script="true"), now=time.time())
+        assert _wait(
+            lambda: sched.job_info(jid) is not None
+            and sched.job_info(jid).status == JobStatus.COMPLETED,
+            timeout=30.0)
+        snap = REGISTRY.snapshot()
+        assert any(v["count"] >= 1 for v in
+                   snap["crane_supervisor_spawn_seconds"]
+                   ["values"].values())
+        assert any("create" in k for k in
+                   snap["crane_cgroup_op_seconds"]["values"])
+    finally:
+        daemon.stop()
+        dispatcher.close()
+        server.stop()

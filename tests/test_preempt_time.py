@@ -47,15 +47,15 @@ def _jobs(entries, num_nodes, num_victims):
     req = np.zeros((j, R), np.int32)
     nn = np.zeros(j, np.int32)
     tl = np.zeros(j, np.int32)
-    db = np.zeros(j, np.int32)
     pm = np.ones((j, num_nodes), bool)
     ex = np.zeros(j, bool)
     prey = np.zeros((j, num_victims), bool)
     for i, e in enumerate(entries):
         req[i] = e["req"]
         nn[i] = e.get("node_num", 1)
-        db[i] = e["dur"]
-        tl[i] = e["dur"] * 60
+        # unit grid (edges=None): 1 bucket == 1 second, so the
+        # duration in buckets is the time_limit itself
+        tl[i] = e["dur"]
         ex[i] = e.get("ex", False)
         for v in e.get("prey", ()):
             prey[i, v] = True
@@ -63,7 +63,7 @@ def _jobs(entries, num_nodes, num_victims):
             pm[i] = e["mask"]
     return TimedPreemptorBatch(
         req=jnp.asarray(req), node_num=jnp.asarray(nn),
-        time_limit=jnp.asarray(tl), dur_buckets=jnp.asarray(db),
+        time_limit=jnp.asarray(tl),
         part_mask=jnp.asarray(pm), exclusive=jnp.asarray(ex),
         can_prey=jnp.asarray(prey), valid=jnp.ones(j, bool))
 
@@ -276,7 +276,7 @@ def test_oracle_parity_randomized(seed):
                    valid=np.asarray(tv.rows.valid))
     oracle_jobs = [
         (np.asarray(jobs.req[i]), int(jobs.node_num[i]),
-         int(jobs.time_limit[i]), int(jobs.dur_buckets[i]),
+         int(jobs.time_limit[i]), int(jobs.time_limit[i]),
          np.asarray(jobs.part_mask[i]), bool(jobs.exclusive[i]),
          np.asarray(jobs.can_prey[i]), bool(jobs.valid[i]))
         for i in range(J)]

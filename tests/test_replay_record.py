@@ -1,0 +1,25 @@
+"""REPLAY_r04.json is a checked-in measurement record (round-4 replay
+runs: direct-drive and rpc+wal full-replay throughput).  It is quoted
+by test_lock_break.py and VERDICT notes, so keep it loadable and
+self-consistent."""
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_replay_record_loads_and_is_consistent():
+    with open(os.path.join(HERE, "REPLAY_r04.json")) as fh:
+        doc = json.load(fh)
+    assert set(doc) == {"fifo_full_direct", "fifo_full_rpc_wal",
+                        "minload_full_direct"}
+    for scenario, runs in doc.items():
+        for policy, r in runs.items():
+            assert r["jobs_finished"] == r["completed"] > 0, scenario
+            assert r["wall_s"] > 0 and r["cycles"] > 0, scenario
+            # the recorded rate matches finished/wall (loose: the
+            # record rounds to 3 significant-ish digits)
+            rate = r["jobs_finished"] / r["wall_s"]
+            assert abs(rate - r["jobs_per_wall_s"]) / rate < 0.01, (
+                scenario, policy, rate, r["jobs_per_wall_s"])
