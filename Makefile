@@ -6,8 +6,12 @@
 # tier1-obs  — fast lane: only the observability tests (@pytest.mark.obs
 #              in tests/test_obs.py) — seconds, not minutes.  Use while
 #              iterating on obs/, the cycle trace, or the watchdog.
+# tier1-perf — prelude smoke lane (tools/tier1_perf.sh): bench.py at a
+#              tiny CPU shape, asserting the scheduler cycle's prelude
+#              share stays <= 25% of wall time (guards the factored
+#              mask table / stable-jit-shape prelude work).
 
-.PHONY: tier1 tier1-obs
+.PHONY: tier1 tier1-obs tier1-perf
 
 tier1:
 	bash tools/tier1.sh
@@ -15,3 +19,6 @@ tier1:
 tier1-obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-perf:
+	bash tools/tier1_perf.sh

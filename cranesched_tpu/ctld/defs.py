@@ -324,6 +324,13 @@ class Job:
     # (derived state — not persisted; cleared on requeue)
     alloc_cache: list | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # cached solver-batch row ``(spec, (encoded req, node_num,
+    # time_limit))`` — modify_job REPLACES job.spec
+    # (dataclasses.replace), so a plain identity check on the first
+    # element invalidates exactly when the row could change (derived
+    # state — not persisted)
+    row_cache: tuple | None = dataclasses.field(
+        default=None, repr=False, compare=False)
     # run-limit usage actually taken for this incarnation (keeps the
     # accounting free symmetric even if the QoS is deleted mid-run)
     run_usage_taken: bool = dataclasses.field(

@@ -55,6 +55,7 @@ from cranesched_tpu.models.solver import (
     REASON_CONSTRAINT,
     REASON_RESOURCE,
     ClusterState,
+    FactoredJobBatch,
     JobBatch,
     Placements,
     make_cluster_state,
@@ -182,6 +183,105 @@ class StatusChange:
     incarnation: int | None = None
 
 
+class _MaskTable:
+    """Device-resident ``[C, N]`` eligibility-row table — the factored
+    form of the per-job ``part_mask``.
+
+    Rows are pure functions of a job's *class key* (partition +
+    include/exclude lists + reservation identity/activity + the set of
+    reservations overlapping the job's runtime window — see
+    ``JobScheduler._class_key``), deduplicated by CONTENT so distinct
+    keys with identical masks share one row.  The device table is
+    bucketed (power-of-two row count, all-False padding) so solver jit
+    shapes stay stable as classes appear, and row 0 is ALWAYS the
+    all-False row: padding jobs gather an empty mask, exactly matching
+    the dense builder's zero rows.
+
+    Invalidation: a ``resv_epoch`` bump or node-count change drops
+    everything (the same rule as the scalar ``_mask_cache``); within an
+    epoch rows never mutate, so the [C, N] host→device transfer happens
+    only when a NEW class appears — the per-cycle upload shrinks from
+    O(J·N) to O(J + changed rows).
+    """
+
+    def __init__(self):
+        self.epoch = -1
+        self.num_nodes = -1
+        self.key_to_class: dict[tuple, int] = {}
+        self._bytes_to_class: dict[bytes, int] = {}
+        self.rows: list[np.ndarray] = []
+        self.rows_np: np.ndarray | None = None  # padded [Cpad, N] mirror
+        self.table = None                       # jnp twin of rows_np
+        self.disjoint = True      # no node is in 2+ rows (see node_class)
+        self._node_class: np.ndarray | None = None
+        self.h2d_rows = 0         # rows shipped to device (observability)
+        self.refreshes = 0        # full invalidations (observability)
+
+    def reset(self, epoch: int, num_nodes: int) -> None:
+        self.epoch = epoch
+        self.num_nodes = num_nodes
+        self.key_to_class.clear()
+        self._bytes_to_class.clear()
+        row0 = np.zeros(max(num_nodes, 1), bool)
+        self.rows = [row0]
+        self._bytes_to_class[row0.tobytes()] = 0
+        self.rows_np = None
+        self.table = None
+        self.disjoint = True
+        self._node_class = None
+        self.refreshes += 1
+
+    def class_for(self, key: tuple, row_fn) -> int:
+        """Class id for ``key``; ``row_fn()`` builds the [N] bool row
+        only on first sight of the key."""
+        cid = self.key_to_class.get(key)
+        if cid is None:
+            row = np.ascontiguousarray(row_fn(), dtype=bool)
+            b = row.tobytes()
+            cid = self._bytes_to_class.get(b)
+            if cid is None:
+                cid = len(self.rows)
+                self.rows.append(row)
+                self._bytes_to_class[b] = cid
+                self.rows_np = None   # grew: rebuild the mirrors lazily
+                self.table = None
+                self._node_class = None
+            self.key_to_class[key] = cid
+        return cid
+
+    def tables(self):
+        """``(host [Cpad, N] bool, device twin)`` — padded to a
+        power-of-two row count with all-False rows."""
+        if self.rows_np is None or self.table is None:
+            c = 1
+            while c < len(self.rows):
+                c *= 2
+            padded = np.zeros((c, self.rows[0].shape[0]), bool)
+            padded[: len(self.rows)] = self.rows
+            self.rows_np = padded
+            self.disjoint = bool(
+                (padded.sum(axis=0, dtype=np.int64) <= 1).all())
+            self.table = jnp.asarray(padded)
+            self.h2d_rows += len(self.rows)
+        return self.rows_np, self.table
+
+    def node_class(self) -> np.ndarray | None:
+        """Per-node owner class id iff the rows are pairwise disjoint —
+        then ``rows[c] == (node_class == c)`` exactly, which feeds the
+        native solver's partition-id fast path (no dense [J, N] mask
+        materialized at all).  Unowned nodes get a label no job carries.
+        None when rows overlap (caller falls back to a dense gather)."""
+        rows_np, _ = self.tables()
+        if not self.disjoint:
+            return None
+        if self._node_class is None:
+            owner = np.full(rows_np.shape[1], rows_np.shape[0], np.int32)
+            cls, node = np.nonzero(rows_np)
+            owner[node] = cls
+            self._node_class = owner
+        return self._node_class
+
+
 class JobScheduler:
     """Owns the pending/running maps and drives scheduling cycles.
 
@@ -232,6 +332,9 @@ class JobScheduler:
         self._account_index: dict[str, int] = {}
         self._mask_cache: dict[tuple, np.ndarray] = {}
         self._mask_cache_epoch = -1
+        # factored eligibility classes: the [C, N] row table lives on
+        # device across cycles; per-cycle H2D is job_class[J] only
+        self._mask_table = _MaskTable()
         self._mesh = None  # lazy device mesh for solver == "sharded"
         self._dependents: dict[int, set[int]] = {}  # dep job -> waiters
         # job_id -> last kill-send time for unconfirmed cancel intents
@@ -1415,7 +1518,7 @@ class JobScheduler:
         self._cur_trace = {
             "now": now, "queue_depth": len(self.pending),
             "solver": "", "solve_ms": 0.0,
-            "preempted": 0, "backfilled": 0,
+            "preempted": 0, "backfilled": 0, "num_streams": 1,
         }
         _MET_PENDING.set(len(self.pending))
         self.process_status_changes()
@@ -1472,7 +1575,7 @@ class JobScheduler:
                      or j.spec.ntasks_per_node_max > 1 for j in ordered)
         if packed:
             state = make_cluster_state(avail, total, alive, cost0)
-            pbatch = self._packed_batch(jobs_batch, ordered)
+            pbatch = self._packed_batch(jobs_batch.dense, ordered)
             placements = yield self._traced_solve(
                 "packed", lambda: solve_packed(
                     state, pbatch, max_nodes=max_nodes)[0])
@@ -1496,7 +1599,7 @@ class JobScheduler:
                                          "backfill-split")
                 return started
             state = self._timed_state(now, avail, total, alive, cost0)
-            tbatch = self._timed_batch(jobs_batch, ordered)
+            tbatch = self._timed_batch(jobs_batch.dense, ordered)
             placements = yield self._traced_solve(
                 "backfill", lambda: solve_backfill(
                     state, tbatch, edges=self._grid.jnp_edges,
@@ -1535,12 +1638,14 @@ class JobScheduler:
                                              jobs_batch, max_nodes)
             solver_name = "sharded"
         if placements is None and self.config.solver == "pallas":
-            placements = self._solve_pallas(avail, total, alive, cost0,
-                                            jobs_batch, max_nodes)
-            solver_name = "pallas"
+            placements, solver_name = self._solve_pallas(
+                avail, total, alive, cost0, jobs_batch, max_nodes)
         if placements is None:
             state = make_cluster_state(avail, total, alive, cost0)
-            placements, _ = solve_greedy(state, jobs_batch,
+            dense = (jobs_batch.dense
+                     if isinstance(jobs_batch, FactoredJobBatch)
+                     else jobs_batch)
+            placements, _ = solve_greedy(state, dense,
                                          max_nodes=max_nodes)
         return placements, solver_name
 
@@ -1557,21 +1662,22 @@ class JobScheduler:
         head, tail = ordered[:bf_max], ordered[bf_max:]
 
         # slice the already-built batch — rebuilding it would pay the
-        # dense [J, N] part_mask twice per cycle in exactly the regime
-        # this split exists to keep fast.  The bucketed head keeps the
-        # jit cache small; the tail reuses the full batch rows with the
-        # head rows invalidated (padding-style no-ops).
+        # prelude twice per cycle in exactly the regime this split
+        # exists to keep fast.  The head needs dense rows anyway (the
+        # timed solver gathers per-job masks), so slice the device-side
+        # gather; the tail STAYS factored — the immediate solve it feeds
+        # is exactly the path the [C, N] table exists for.
         import jax
 
         hb = self._bucket(len(head))
-        head_batch = jax.tree.map(lambda x: x[:hb], jobs_batch)
+        head_batch = jax.tree.map(lambda x: x[:hb], jobs_batch.dense)
         # rows past len(head) in the bucketed slice are REAL tail jobs —
         # invalidate them or they would place in both passes
         head_batch = head_batch.replace(valid=head_batch.valid & (
             jnp.arange(hb) < len(head)))
         tail_valid = jobs_batch.valid & (
             jnp.arange(jobs_batch.valid.shape[0]) >= bf_max)
-        tail_batch = jobs_batch.replace(valid=tail_valid)
+        tail_batch = jobs_batch.with_valid(tail_valid)
 
         state = self._timed_state(now, avail, total, alive, cost0)
         tb = self._timed_batch(head_batch, head)
@@ -1614,8 +1720,20 @@ class JobScheduler:
         def run():
             label = backend or "immediate"
             t0 = _time.perf_counter()
+            # the cycle's PRELUDE ends when the first solve starts:
+            # priority sort + batch build + stream planning all count
+            # toward it (that is the span the device-resident tables
+            # exist to shrink, and what bench/tier1-perf assert on)
+            trace.setdefault("_prelude_end", t0)
             with solve_span(f"crane:solve:{label}"):
                 out = fn()
+            # settle async device work before stopping the clock —
+            # otherwise jax's deferred execution charges the whole
+            # solve to the commit phase (the np.asarray sync there)
+            first = out[0] if isinstance(out, tuple) else out
+            sync = getattr(first, "placed", None)
+            if hasattr(sync, "block_until_ready"):
+                sync.block_until_ready()
             dt = _time.perf_counter() - t0
             if (backend is None and isinstance(out, tuple)
                     and len(out) == 2 and isinstance(out[1], str)):
@@ -1634,7 +1752,13 @@ class JobScheduler:
         self.stats["jobs_started_total"] += len(started)
         _MET_STARTED.inc(len(started))
         total_ms = (t_end - t0) * 1e3
-        prelude_ms = (t_prelude - t0) * 1e3
+        drain_ms = (t_prelude - t0) * 1e3
+        # prelude = everything before the FIRST solve closure started
+        # (status drains + sort + batch build); cycles that never solved
+        # fall back to the drain span
+        prelude_end = self._cur_trace.pop("_prelude_end", None)
+        prelude_ms = (drain_ms if prelude_end is None
+                      else (prelude_end - t0) * 1e3)
         solve_ms = float(self._cur_trace.get("solve_ms", 0.0))
         # commit = everything after the prelude that ran under the
         # lock, i.e. total minus prelude minus the lock-released solves
@@ -1652,6 +1776,7 @@ class JobScheduler:
         trace = self._cur_trace
         trace.update(
             solver=solver,
+            drain_ms=round(drain_ms, 3),
             prelude_ms=round(prelude_ms, 3),
             solve_ms=round(solve_ms, 3),
             commit_ms=round(commit_ms, 3),
@@ -1676,13 +1801,30 @@ class JobScheduler:
         class _Shim:
             pass
 
-        out = native.solve_greedy_native(
-            avail, total, alive.astype(np.uint8), cost0,
-            np.asarray(jobs_batch.req), np.asarray(jobs_batch.node_num),
-            np.asarray(jobs_batch.time_limit),
-            np.asarray(jobs_batch.valid).astype(np.uint8),
-            max_nodes=max_nodes,
-            mask=np.asarray(jobs_batch.part_mask))
+        common = (avail, total, alive.astype(np.uint8), cost0,
+                  np.asarray(jobs_batch.req),
+                  np.asarray(jobs_batch.node_num),
+                  np.asarray(jobs_batch.time_limit),
+                  np.asarray(jobs_batch.valid).astype(np.uint8))
+        if isinstance(jobs_batch, FactoredJobBatch):
+            node_class = jobs_batch.node_class_np
+            if node_class is not None:
+                # factored fast path: class ids in, no [J, N] mask
+                # materialized anywhere (partition-id mode)
+                out = native.solve_greedy_native(
+                    *common, max_nodes=max_nodes,
+                    job_part=jobs_batch.job_class_np,
+                    node_part=node_class)
+            else:
+                # overlapping classes: host gather of the C rows —
+                # still no per-job _mask_for rebuild
+                out = native.solve_greedy_native(
+                    *common, max_nodes=max_nodes,
+                    mask=jobs_batch.dense_mask_np())
+        else:
+            out = native.solve_greedy_native(
+                *common, max_nodes=max_nodes,
+                mask=np.asarray(jobs_batch.part_mask))
         if out is None:
             return None
         shim = _Shim()
@@ -1696,12 +1838,11 @@ class JobScheduler:
         per-job candidate merge rides ICI all_gathers.  Bit-identical
         placements to solve_greedy (tests/test_sharded_parity.py);
         the multichip dryrun asserts the same through this exact path."""
-        import jax as _jax
-
         from cranesched_tpu.parallel.sharded import (
             make_node_mesh,
             shard_cluster_state,
             solve_greedy_sharded,
+            solve_greedy_sharded_classes,
         )
 
         if self._mesh is None:
@@ -1710,6 +1851,8 @@ class JobScheduler:
         d = mesh.devices.size
         n = avail.shape[0]
         pad = (-n) % d
+        factored = isinstance(jobs_batch, FactoredJobBatch)
+        class_masks = jobs_batch.class_masks if factored else None
         if pad:
             # pad with permanently-dead nodes so the node axis divides
             # the mesh; they are never eligible, so placements and the
@@ -1720,31 +1863,71 @@ class JobScheduler:
             alive = np.concatenate([alive, np.zeros(pad, bool)])
             cost0 = np.concatenate(
                 [cost0, np.zeros(pad, cost0.dtype)])
-            jobs_batch = jobs_batch.replace(part_mask=jnp.pad(
-                jobs_batch.part_mask, ((0, 0), (0, pad)),
-                constant_values=False))
+            if factored:
+                class_masks = jnp.pad(class_masks, ((0, 0), (0, pad)),
+                                      constant_values=False)
+            else:
+                jobs_batch = jobs_batch.replace(part_mask=jnp.pad(
+                    jobs_batch.part_mask, ((0, 0), (0, pad)),
+                    constant_values=False))
         state = make_cluster_state(avail, total, alive, cost0)
         state = shard_cluster_state(state, mesh)
-        placements, _ = solve_greedy_sharded(state, jobs_batch, mesh,
-                                             max_nodes=max_nodes)
+        if factored:
+            # class-factored path: the [C, N] table is the only mask
+            # that crosses the host→device boundary, and class-disjoint
+            # batches decode S jobs per collective round (streamed)
+            placements, _ = solve_greedy_sharded_classes(
+                state, jobs_batch.req, jobs_batch.node_num,
+                jobs_batch.time_limit, jobs_batch.valid,
+                jobs_batch.job_class, class_masks, mesh,
+                max_nodes=max_nodes)
+        else:
+            placements, _ = solve_greedy_sharded(
+                state, jobs_batch, mesh, max_nodes=max_nodes)
         return placements
 
     def _solve_pallas(self, avail, total, alive, cost0, jobs_batch,
                       max_nodes):
-        """Single-kernel TPU solve (models/pallas_solver.py).  Eligibility
-        classes are rebuilt host-side from the batch's mask rows; on
-        non-TPU backends the kernel runs in interpret mode (tests)."""
+        """Single-kernel TPU solve (models/pallas_solver.py), returning
+        ``(placements, label)``.  A factored batch feeds the kernel its
+        class table directly (no dense mask anywhere); class-disjoint
+        batches run the S-stream decomposition, labeled
+        ``pallas-stream`` with ``num_streams`` in the cycle trace.  On
+        TPU the cluster-state buffers are donated — they are rebuilt
+        from host snapshots each cycle, so the solve may overwrite them
+        in place.  Non-TPU backends run in interpret mode (tests)."""
         import jax as _jax
 
         from cranesched_tpu.models.pallas_solver import (
+            plan_streams,
+            solve_greedy_pallas_auto,
             solve_greedy_pallas_from_batch,
         )
 
+        on_tpu = _jax.default_backend() == "tpu"
         state = make_cluster_state(avail, total, alive, cost0)
-        placements, _ = solve_greedy_pallas_from_batch(
-            state, jobs_batch, max_nodes=max_nodes,
-            interpret=_jax.default_backend() != "tpu")
-        return placements
+        if not isinstance(jobs_batch, FactoredJobBatch):
+            placements, _ = solve_greedy_pallas_from_batch(
+                state, jobs_batch, max_nodes=max_nodes,
+                interpret=not on_tpu)
+            return placements, "pallas"
+        plan = None
+        if self._mask_table.disjoint:
+            # the table already proved its rows disjoint (cached per
+            # epoch) — the planner skips its [C, N] host reduction
+            plan = plan_streams(jobs_batch.job_class_np,
+                                jobs_batch.class_rows_np,
+                                known_disjoint=True)
+        num_streams = plan[1] if plan is not None else 1
+        self._cur_trace["num_streams"] = num_streams
+        placements, _ = solve_greedy_pallas_auto(
+            state, jobs_batch.req, jobs_batch.node_num,
+            jobs_batch.time_limit, jobs_batch.valid,
+            jobs_batch.job_class, jobs_batch.class_masks,
+            max_nodes=max_nodes, interpret=not on_tpu,
+            donate=on_tpu, plan=plan)
+        return placements, ("pallas-stream" if num_streams > 1
+                            else "pallas")
 
     def _initial_cost_reference(self, now: float,
                                 total: np.ndarray) -> np.ndarray:
@@ -1775,6 +1958,19 @@ class JobScheduler:
         # ledger — O(rows) numpy, no Python loop over running jobs
         run_nodes, run_req, run_end = self._ledger.timed_rows(
             now, res, T, grid=self._grid)
+        # bucket the row count: the running set changes by a few rows
+        # every cycle, and each fresh shape recompiles the release
+        # scatter (measured ~300 ms/cycle of prelude).  Padding rows use
+        # node -1, which the scatter drops as out-of-bounds
+        m = run_nodes.shape[0]
+        mp = self._bucket(m)
+        if mp != m:
+            run_nodes = np.concatenate([run_nodes, np.full(
+                (mp - m, run_nodes.shape[1]), -1, np.int32)])
+            run_req = np.concatenate([run_req, np.zeros(
+                (mp - m, run_req.shape[1]), np.int32)])
+            run_end = np.concatenate([run_end, np.full(
+                mp - m, T, np.int32)])
         return make_timed_state(avail, total, alive, run_nodes, run_req,
                                 run_end, T, cost0)
 
@@ -2201,7 +2397,6 @@ class JobScheduler:
         if self.config.priority_type == "basic" or not candidates:
             return candidates  # FIFO: id order (JobScheduler.h:183-201)
 
-        lay = self.meta.layout
         for job in candidates:
             self._account_id(job.spec.account)
         for job in self.running.values():
@@ -2211,7 +2406,7 @@ class JobScheduler:
         num_accounts = self._bucket(len(self._account_index))
 
         def job_row(job: Job):
-            req = job.spec.res.encode(lay)
+            req = self._job_row(job)[0]   # spec-cached encode
             total_cpu = float(req[DIM_CPU]) / 256.0 * job.spec.node_num
             total_mem = float(req[DIM_MEM]) * job.spec.node_num
             return (job.qos_priority,
@@ -2322,30 +2517,85 @@ class JobScheduler:
                         mask[n] = False
         return mask
 
+    def _job_row(self, job: Job) -> tuple:
+        """``(encoded req, node_num, time_limit)`` cached on the Job:
+        modify_job REPLACES job.spec, so an ``is`` check on the cached
+        spec invalidates exactly when the row could change.  Saves the
+        per-cycle re-encode for every job that sits in the queue across
+        many cycles (the common case at depth)."""
+        cached = job.row_cache
+        if cached is not None and cached[0] is job.spec:
+            return cached[1]
+        row = (job.spec.res.encode(self.meta.layout),
+               int(job.spec.node_num), int(job.spec.time_limit))
+        job.row_cache = (job.spec, row)
+        return row
+
+    def _class_key(self, job: Job, now: float) -> tuple:
+        """Eligibility-class key: equal keys provably produce identical
+        ``_mask_for`` rows within one resv_epoch, so the row is cacheable
+        for the whole epoch.  The post-cache dynamic parts of _mask_for
+        depend only on (a) the job's reservation being active at ``now``
+        and (b) the set of reservations overlapping [now, now+limit] —
+        both are folded into the key."""
+        spec = job.spec
+        base = (spec.partition, tuple(spec.include_nodes),
+                tuple(spec.exclude_nodes), spec.reservation)
+        if spec.reservation:
+            resv = self.meta.reservations.get(spec.reservation)
+            return base + (resv is not None and resv.active(now),)
+        if not self.meta.reservations:
+            return base
+        end = now + spec.time_limit
+        return base + (frozenset(
+            name for name, r in self.meta.reservations.items()
+            if now < r.end_time and r.start_time < end),)
+
+    def _refresh_mask_table(self) -> None:
+        """Same invalidation rule as ``_mask_cache`` (resv_epoch), plus a
+        node-count guard (rows are [N]) and a size backstop: within one
+        epoch the moving ``now`` can mint fresh overlap sets every cycle,
+        and the table must not grow without bound.  Called ONCE per cycle
+        (before the batch loop) — resetting mid-batch would orphan class
+        ids already assigned to earlier jobs in the same batch."""
+        table = self._mask_table
+        if (table.epoch != self.meta.resv_epoch
+                or table.num_nodes != len(self.meta.nodes)
+                or len(table.rows) > 512):
+            table.reset(self.meta.resv_epoch, len(self.meta.nodes))
+
+    def _class_for(self, job: Job, now: float) -> int:
+        return self._mask_table.class_for(
+            self._class_key(job, now), lambda: self._mask_for(job, now))
+
     def _build_batch(self, ordered: list[Job], num_nodes: int,
-                     now: float = 0.0) -> tuple[JobBatch, int]:
+                     now: float = 0.0) -> tuple[FactoredJobBatch, int]:
         lay = self.meta.layout
         J = self._bucket(len(ordered))
         req = np.zeros((J, lay.num_dims), np.int32)
         node_num = np.zeros(J, np.int32)
         time_limit = np.zeros(J, np.int32)
-        part_mask = np.zeros((J, num_nodes), bool)
+        # padding rows keep class 0 — the table's permanent all-False
+        # row — so a dense gather reproduces the old zero-padded mask
+        job_class = np.zeros(J, np.int32)
         valid = np.zeros(J, bool)
+        self._refresh_mask_table()
         for i, job in enumerate(ordered):
-            req[i] = job.spec.res.encode(lay)
-            node_num[i] = job.spec.node_num
-            time_limit[i] = job.spec.time_limit
-            part_mask[i] = self._mask_for(job, now)
+            req[i], node_num[i], time_limit[i] = self._job_row(job)
+            job_class[i] = self._class_for(job, now)
             valid[i] = True
         max_nodes = max(1, min(int(node_num.max(initial=1)),
                                self.config.max_nodes_per_job))
         # bucket the static gang bound too (it is a jit static arg)
         max_nodes = self._bucket(max_nodes, floor=1)
-        batch = JobBatch(req=jnp.asarray(req),
-                         node_num=jnp.asarray(node_num),
-                         time_limit=jnp.asarray(time_limit),
-                         part_mask=jnp.asarray(part_mask),
-                         valid=jnp.asarray(valid))
+        rows_np, table = self._mask_table.tables()
+        batch = FactoredJobBatch(
+            req=jnp.asarray(req), node_num=jnp.asarray(node_num),
+            time_limit=jnp.asarray(time_limit),
+            valid=jnp.asarray(valid), job_class=jnp.asarray(job_class),
+            class_masks=table, job_class_np=job_class,
+            class_rows_np=rows_np,
+            node_class_np=self._mask_table.node_class())
         return batch, max_nodes
 
     def _commit(self, ordered: list[Job], placements: Placements,

@@ -165,6 +165,70 @@ class JobBatch:
         return self.req.shape[0]
 
 
+class FactoredJobBatch:
+    """A job batch whose eligibility is FACTORED: a per-job class id into
+    a small device-resident ``class_masks[C, N]`` table instead of the
+    dense ``part_mask[J, N]`` matrix.
+
+    At the north-star shape (100k jobs x 10k nodes) the dense matrix is a
+    1 GB bool rebuilt row-by-row on the host and re-transferred every
+    cycle; the factored form ships ``job_class[J]`` (400 KB) per cycle
+    plus the [C, N] table only when a row actually changed
+    (reservation/partition churn — see JobScheduler._mask_table).  Dense
+    consumers (the scan/backfill solvers) gather ``class_masks[job_class]``
+    ON DEVICE via :meth:`dense`, so the host never materializes [J, N].
+
+    Not a pytree on purpose: the host-side mirrors (``job_class_np``,
+    ``class_rows_np``, ``node_class_np``) ride along for the native C++
+    solver and the stream planner, and must not be traced.
+    """
+
+    def __init__(self, req, node_num, time_limit, valid, job_class,
+                 class_masks, job_class_np, class_rows_np,
+                 node_class_np=None):
+        self.req = req                    # int32[J, R] (device)
+        self.node_num = node_num          # int32[J]
+        self.time_limit = time_limit      # int32[J]
+        self.valid = valid                # bool[J]
+        self.job_class = job_class        # int32[J] (device)
+        self.class_masks = class_masks    # bool[C, N] (device table)
+        self.job_class_np = job_class_np  # int32[J] host mirror
+        self.class_rows_np = class_rows_np  # bool[C0, N] host rows
+        self.node_class_np = node_class_np  # int32[N] iff rows disjoint
+        self._dense: JobBatch | None = None
+
+    @property
+    def num_jobs(self) -> int:
+        return self.req.shape[0]
+
+    @property
+    def dense(self) -> "JobBatch":
+        """Dense JobBatch with ``part_mask`` gathered on device (cached)."""
+        if self._dense is None:
+            self._dense = JobBatch(
+                req=self.req, node_num=self.node_num,
+                time_limit=self.time_limit,
+                part_mask=self.class_masks[self.job_class],
+                valid=self.valid)
+        return self._dense
+
+    def dense_mask_np(self):
+        """Host-side dense mask (numpy gather) for host solvers that
+        need rows but can't use the factored form."""
+        import numpy as np
+        return np.asarray(self.class_rows_np)[self.job_class_np]
+
+    def with_valid(self, valid) -> "FactoredJobBatch":
+        """Same batch with a replaced validity mask (shares the tables)."""
+        return FactoredJobBatch(
+            req=self.req, node_num=self.node_num,
+            time_limit=self.time_limit, valid=valid,
+            job_class=self.job_class, class_masks=self.class_masks,
+            job_class_np=self.job_class_np,
+            class_rows_np=self.class_rows_np,
+            node_class_np=self.node_class_np)
+
+
 @struct.dataclass
 class Placements:
     """Solve output, aligned with the input job order.

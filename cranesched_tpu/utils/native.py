@@ -25,14 +25,20 @@ _tried = False
 
 
 def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             "-o", _SO, _SRC],
-            check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
+    # -march=native vectorizes the solver's per-dimension loops for the
+    # host the .so is built on (it is always compiled locally, never
+    # shipped).  No -ffast-math: QuantizedDcost's round-half-to-even
+    # must stay bit-identical to the JAX ledger.
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    for extra in (["-march=native"], []):
+        try:
+            subprocess.run(
+                base + extra + ["-o", _SO, _SRC],
+                check=True, capture_output=True, timeout=120)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return False
 
 
 def load():

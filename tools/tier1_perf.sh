@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# tier1-perf — cycle-prelude smoke lane (`make tier1-perf`).
+#
+# Runs bench.py at a tiny CPU shape and asserts the scheduler-cycle
+# phase split it records: the prelude (status drains + priority sort +
+# batch build) must stay a small share of cycle wall time.  This is the
+# guard for the device-resident prelude work — a regression that
+# reintroduces a per-cycle dense [J, N] mask build or an unstable jit
+# shape (recompile every cycle) shows up here as a prelude blow-up,
+# without waiting for the full-scale bench.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out=$(timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  BENCH_JOBS=2048 BENCH_NODES=256 BENCH_REPEATS=2 BENCH_SOLVER=native \
+  BENCH_SCHED_JOBS=2048 BENCH_SCHED_NODES=256 \
+  python bench.py)
+echo "$out"
+python - "$out" <<'PY'
+import json
+import sys
+
+doc = json.loads(sys.argv[1])
+sc = doc["detail"]["sched_cycle"]
+assert sc and "error" not in sc, f"sched_cycle measurement failed: {sc}"
+share = sc["prelude_share"]
+assert share <= 0.25, (
+    f"prelude is {share:.1%} of cycle wall time (limit 25%): {sc}")
+print(f"TIER1_PERF_OK prelude_share={share:.3f} solver={sc['solver']}")
+PY
