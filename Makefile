@@ -10,8 +10,12 @@
 #              tiny CPU shape, asserting the scheduler cycle's prelude
 #              share stays <= 25% of wall time (guards the factored
 #              mask table / stable-jit-shape prelude work).
+# tier1-ha   — HA failover lane (@pytest.mark.ha in
+#              tests/test_ha_failover.py): leader+standby e2e — kill
+#              the leader, assert promotion, fencing, and no lost or
+#              double-dispatched jobs.
 
-.PHONY: tier1 tier1-obs tier1-perf
+.PHONY: tier1 tier1-obs tier1-perf tier1-ha
 
 tier1:
 	bash tools/tier1.sh
@@ -22,3 +26,7 @@ tier1-obs:
 
 tier1-perf:
 	bash tools/tier1_perf.sh
+
+tier1-ha:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m ha \
+	  -p no:cacheprovider -p no:xdist -p no:randomly

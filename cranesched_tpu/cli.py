@@ -121,8 +121,10 @@ def _tls(args):
 
 
 def _client(args):
-    from cranesched_tpu.rpc.client import CtldClient
-    return CtldClient(args.server, token=_token(args), tls=_tls(args))
+    # a comma-separated --server/$CRANE_SERVER is an HA pair: the
+    # client follows the leader across failovers
+    from cranesched_tpu.rpc.client import make_client
+    return make_client(args.server, token=_token(args), tls=_tls(args))
 
 
 def cmd_ctoken(args) -> int:
@@ -581,6 +583,32 @@ def cmd_ccancel(args) -> int:
     return rc
 
 
+def cmd_crequeue(args) -> int:
+    """Stop a running job and put it back in the queue (the reference's
+    RequeueJob surface, Crane.proto:1407)."""
+    client = _client(args)
+    rc = 0
+    for job_id in args.job_ids:
+        reply = client.requeue(job_id)
+        if not reply.ok:
+            print(f"crequeue {job_id}: {reply.error}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_csummary(args) -> int:
+    """Aggregated per-state job counts (the reference's
+    QueryJobSummary, Crane.proto:1588) — one small reply instead of
+    streaming the whole queue."""
+    client = _client(args)
+    reply = client.query_job_summary(user=args.user,
+                                     partition=args.partition)
+    rows = [(s.status, s.count) for s in reply.states]
+    print(_fmt_table(rows, ("STATE", "COUNT")))
+    print(f"# total {reply.total}")
+    return 0
+
+
 def cmd_cnode(args) -> int:
     client = _client(args)
     reply = client.modify_node(args.node, args.action)
@@ -617,6 +645,16 @@ def cmd_cstats(args) -> int:
         print(f"WARNING: {doc['cycle_crashes_total']} scheduler cycle "
               f"crash(es); last at t={crash.get('time')}",
               file=sys.stderr)
+    if getattr(args, "ha", False):
+        h = doc.get("ha") or {}
+        rows = [("role", h.get("role", "leader")),
+                ("fencing_epoch", h.get("fencing_epoch", 0)),
+                ("wal_seq", h.get("wal_seq", 0)),
+                ("replication_lag", h.get("replication_lag", 0)),
+                ("failovers_total", h.get("failovers_total", 0)),
+                ("peer", h.get("peer") or "-")]
+        print(_fmt_table(rows, ("HA", "VALUE")))
+        return 0
     if getattr(args, "cycles", False):
         rows = [(t.get("now"), t.get("solver"), t.get("queue_depth"),
                  t.get("candidates"), t.get("placed"),
@@ -836,7 +874,9 @@ def build_parser() -> argparse.ArgumentParser:
     top = argparse.ArgumentParser(prog="crane")
     top.add_argument("--server",
                      default=os.environ.get("CRANE_SERVER",
-                                            "127.0.0.1:50051"))
+                                            "127.0.0.1:50051"),
+                     help="ctld address, or a comma-separated HA pair "
+                          "(the client follows the leader)")
     top.add_argument("--token", default="",
                      help="bearer token (default: $CRANE_TOKEN or "
                           "~/.crane/token)")
@@ -1052,7 +1092,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the last-N cycle trace ring as a table")
     p.add_argument("--metrics", action="store_true",
                    help="print the metric registry snapshot as a table")
+    p.add_argument("--ha", action="store_true",
+                   help="print HA role / fencing epoch / replication "
+                        "lag as a table")
     p.set_defaults(func=cmd_cstats)
+
+    p = sub.add_parser("crequeue",
+                       help="stop running jobs and requeue them")
+    p.add_argument("job_ids", nargs="+", type=int)
+    p.set_defaults(func=cmd_crequeue)
+
+    p = sub.add_parser("csummary",
+                       help="per-state job counts (cheap aggregate)")
+    p.add_argument("--user", "-u", default="")
+    p.add_argument("--partition", "-p", default="")
+    p.set_defaults(func=cmd_csummary)
 
     p = sub.add_parser("cacctmgr", help="accounts/users/QoS admin")
     p.add_argument("action",

@@ -38,7 +38,7 @@ from cranesched_tpu.craned.cgroup import (
 from cranesched_tpu.obs import REGISTRY as _OBS
 from cranesched_tpu.ops.resources import gres_key_pair, gres_key_str
 from cranesched_tpu.rpc import crane_pb2 as pb
-from cranesched_tpu.rpc.client import CtldClient
+from cranesched_tpu.rpc.client import make_client
 from cranesched_tpu.rpc.consts import CRANED_SERVICE
 
 
@@ -214,9 +214,16 @@ class CranedDaemon:
             raise ValueError(
                 "craned TLS needs a node cert+key (cpki issue "
                 f"{name}), not just the CA")
-        self._ctld = CtldClient(
+        # comma-separated address list = HA ctld pair: the client
+        # rotates to the standby on UNAVAILABLE / not-leader refusals,
+        # so registration and status upcalls survive a failover
+        self._ctld = make_client(
             ctld_address, timeout=10.0, token=token,
             tls=tls.pinned(tls_name) if tls is not None else None)
+        # highest fencing epoch seen from any ctld (0 = pre-HA ctld,
+        # no fencing).  Stale-leader pushes carry a lower epoch and are
+        # refused — the split-brain half of the HA design
+        self._fencing_epoch = 0
         # allocations (job-level: cgroup + GRES) and the steps running
         # inside them, keyed (job_id, step_id)
         self._allocs: dict[int, _Alloc] = {}
@@ -257,9 +264,29 @@ class CranedDaemon:
 
     # ---- the Craned service (ctld -> craned push) ----
 
+    def _fenced(self, request) -> str:
+        """Fencing guard for every pushed order: latch the highest
+        epoch ever seen and refuse anything older.  A deposed leader's
+        in-flight dispatch (built before it lost the lease) carries the
+        old epoch and dies here instead of double-running a job the new
+        leader already re-placed.  Epoch 0 = a pre-HA ctld: no check
+        (and nothing to latch)."""
+        epoch = getattr(request, "fencing_epoch", 0)
+        if epoch == 0:
+            return ""
+        with self._lock:
+            if epoch > self._fencing_epoch:
+                self._fencing_epoch = epoch
+            elif epoch < self._fencing_epoch:
+                return (f"fenced: request epoch {epoch} < "
+                        f"latched {self._fencing_epoch}")
+        return ""
+
     def AllocJob(self, request, context):
         """Create the allocation only (the AllocJobs half): cgroup +
         GRES hold, no supervisor until steps arrive."""
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         job_id = request.job_id
         with self._lock:
             self._allocating[job_id] = request.incarnation
@@ -283,6 +310,8 @@ class CranedDaemon:
                 self._free_job(job_id, request.incarnation)
 
     def ExecuteStep(self, request, context):
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         key = (request.job_id, request.step_id)
         try:
             self._spawn_step(request)
@@ -305,6 +334,8 @@ class CranedDaemon:
 
     def TerminateStep(self, request, context):
         """Kill one step (step_id present) or every step of the job."""
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         guard = (request.incarnation if request.HasField("incarnation")
                  else None)
         targets = []
@@ -343,6 +374,8 @@ class CranedDaemon:
     def FreeJob(self, request, context):
         """Release the allocation: kill remaining steps, then drop the
         cgroup and GRES (the FreeJobs half)."""
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         guard = (request.incarnation if request.HasField("incarnation")
                  else None)
         self._free_job(request.job_id, guard)
@@ -395,6 +428,8 @@ class CranedDaemon:
         marks the job Running at dispatch; ExecuteStep and this RPC ride
         separate workers) — latch it and apply at spawn registration, or
         the modified deadline would be silently lost to the race."""
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         with self._lock:
             step = self._steps.get((request.job_id, 0))
             if (step is not None and request.incarnation
@@ -416,9 +451,13 @@ class CranedDaemon:
         return pb.OkReply(ok=True)
 
     def SuspendStep(self, request, context):
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         return self._freeze(request.job_id, True)
 
     def ResumeStep(self, request, context):
+        if err := self._fenced(request):
+            return pb.OkReply(ok=False, error=err)
         return self._freeze(request.job_id, False)
 
     def _freeze(self, job_id: int, frozen: bool):
@@ -1363,6 +1402,11 @@ class CranedDaemon:
                       f"{reply.error}", file=sys.stderr, flush=True)
         if reply.ok:
             self.node_id = reply.node_id
+            # learn the ctld's fencing epoch (only ever upward: a
+            # stale leader answering the register must not lower it)
+            with self._lock:
+                if reply.fencing_epoch > self._fencing_epoch:
+                    self._fencing_epoch = reply.fencing_epoch
             # kill stale local steps ctld no longer expects (reference
             # Configure expectations: ctld tells the craned what should
             # be running; anything else died with our old registration)

@@ -17,7 +17,9 @@ import threading
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="craned")
     ap.add_argument("--name", required=True)
-    ap.add_argument("--ctld", required=True)
+    ap.add_argument("--ctld", required=True,
+                    help="ctld address, or a comma-separated list for "
+                         "an HA pair (rotates to the leader)")
     ap.add_argument("--cpu", type=float, default=8.0)
     ap.add_argument("--memory", default="16G")
     ap.add_argument("--partitions", default="default")

@@ -300,6 +300,11 @@ class JobScheduler:
         self.config = config or SchedulerConfig()
         self.dispatch = dispatch or (lambda job, nodes: None)
         self.wal = wal
+        # HA fencing: this ctld's leadership term, stamped into every
+        # craned push/registration by the dispatcher + server so craneds
+        # can reject a deposed leader's in-flight RPCs after failover.
+        # 0 = HA not configured (craneds skip the check).
+        self.fencing_epoch = 0
         # durable history (ctld/archive.JobArchive): terminal jobs are
         # appended BEFORE any WAL purge can drop them (reference
         # PersistAndTransferJobsToMongodb_, JobScheduler.cpp:6918-6948);
@@ -690,6 +695,52 @@ class JobScheduler:
         if self.wal is not None:
             self.wal.job_updated(job)
         return True
+
+    def requeue(self, job_id: int, now: float) -> str:
+        """Operator-requested requeue (reference RequeueJob,
+        Crane.proto:1407): kill the running incarnation and return the
+        job to pending.  Returns "" on success, else the refusal reason.
+
+        Held/pending jobs are refused (nothing to requeue); the kill is
+        incarnation-guarded exactly like the node-death path so a late
+        terminate can never touch the re-placed incarnation."""
+        if job_id in self.pending:
+            return "job is pending; nothing to requeue"
+        job = self.running.get(job_id)
+        if job is None:
+            return "no such running job"
+        if job.cancel_requested:
+            return "cancel already requested"
+        if job.status == JobStatus.SUSPENDED:
+            return "job is suspended; resume it first"
+        self.dispatch_terminate(job_id, now,
+                                incarnation=job.requeue_count)
+        self._release_job_resources(job)
+        del self.running[job_id]
+        self._cancel_kill_sent.pop(job_id, None)
+        job.reset_for_requeue()
+        if job.requeue_count > self.config.max_requeue_count:
+            job.held = True
+            job.pending_reason = PendingReason.HELD
+        self.pending[job_id] = job
+        if self.wal is not None:
+            self.wal.job_requeued(job)
+        return ""
+
+    def job_summary(self, user: str = "", partition: str = ""
+                    ) -> dict[str, int]:
+        """Per-status job counts (reference QueryJobSummary,
+        Crane.proto:1588) over pending + running + in-RAM history."""
+        counts: dict[str, int] = {}
+        for col in (self.pending, self.running, self.history):
+            for job in col.values():
+                if user and job.spec.user != user:
+                    continue
+                if partition and job.spec.partition != partition:
+                    continue
+                key = job.status.name
+                counts[key] = counts.get(key, 0) + 1
+        return counts
 
     def modify_job(self, job_id: int, now: float, *,
                    time_limit: float | None = None,
@@ -2779,6 +2830,26 @@ class JobScheduler:
                 if sat is None:
                     self._dependents.setdefault(dep.job_id, set()).add(
                         job.job_id)
+
+    def rebuild_device_state(self) -> None:
+        """Promotion-time rebuild of device-resident scheduler state.
+
+        A standby's shadow apply only touches the job dicts; after
+        ``recover()`` re-adopts the replicated state, the accelerator-
+        side caches must be rebuilt from scratch before the first cycle:
+        the ``_MaskTable`` [C, N] class-row table (its rows were computed
+        against the OLD leader's device buffers), every per-job row/alloc
+        cache, and the dense mask cache.  The run-ledger rows were
+        re-added by ``recover``; timed-state buckets and the grid
+        re-derive on the first cycle from the refreshed caches."""
+        self._mask_table = _MaskTable()
+        self._mask_cache.clear()
+        self._mask_cache_epoch = -1
+        self._mesh = None
+        for col in (self.pending, self.running):
+            for job in col.values():
+                job.row_cache = None
+                job.alloc_cache = None
 
     def job_info(self, job_id: int) -> Job | None:
         return (self.pending.get(job_id) or self.running.get(job_id)

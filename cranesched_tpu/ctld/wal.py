@@ -12,11 +12,20 @@ Here the WAL is an append-only JSON-lines file — human-debuggable, crash
 append-atomic (one line per event, fsync'd), and replayable in one pass.
 Terminal jobs are retained as ``finalized`` tombstones; ``compact()``
 rewrites the live prefix the way the reference purges finalized rows.
+
+HA additions: every record carries a monotonically increasing ``seq``
+(the replication cursor), recent records are kept in an in-memory tail
+buffer the leader serves to a polling standby, and ``rotate()`` seals
+the active file into a ``.seg.<lastseq>`` segment so a snapshot can
+absorb the prefix and recovery replays snapshot + tail instead of the
+full history.  Records written before the seq field replay as seq 0.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import glob
 import json
 import os
 from typing import IO
@@ -201,9 +210,34 @@ def _job_from_dict(d: dict) -> Job:
     )
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` so a rename/unlink survives
+    a host crash (an os.replace alone is only durable once the directory
+    entry itself is)."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # e.g. O_RDONLY on a dir unsupported — best-effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _segment_files(path: str) -> list[str]:
+    """Sealed segments of ``path``, oldest first (the suffix is the
+    zero-padded last seq in the segment, so lexical order is seq order)."""
+    return sorted(glob.glob(glob.escape(path) + ".seg.*"))
+
+
 class WriteAheadLog:
     """Append-only event log; each event carries the job's full runtime
     record so replay is last-writer-wins per job_id."""
+
+    # records the leader keeps in memory for follower catch-up; a
+    # follower further behind than this re-pulls a full snapshot
+    TAIL_BUFFER = 4096
 
     def __init__(self, path: str, fsync: bool = True):
         """``fsync`` defaults to True: the daemon path must not lose
@@ -213,17 +247,94 @@ class WriteAheadLog:
         fsync=False."""
         self.path = path
         self.fsync = fsync
+        # resume the seq counter past everything durable (sealed
+        # segments may hold the max when the active file is fresh)
+        self.seq = 0
+        for f in _segment_files(path) + [path]:
+            self.seq = max(self.seq, self._scan_max_seq(f))
+        self._tail: collections.deque = collections.deque(
+            maxlen=self.TAIL_BUFFER)
         self._fh: IO[str] = open(path, "a", encoding="utf-8")
+
+    @staticmethod
+    def _scan_max_seq(path: str) -> int:
+        last = 0
+        if not os.path.exists(path):
+            return last
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    last = max(last, json.loads(line).get("seq", 0))
+                except json.JSONDecodeError:
+                    continue  # torn tail
+        return last
 
     def close(self) -> None:
         self._fh.close()
 
     def _append(self, event: str, job: Job) -> None:
-        rec = {"ev": event, "job": _job_to_dict(job)}
-        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self.seq += 1
+        rec = {"seq": self.seq, "ev": event, "job": _job_to_dict(job)}
+        line = json.dumps(rec, separators=(",", ":"))
+        self._fh.write(line + "\n")
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
+        self._tail.append((self.seq, line))
+
+    # -- replication feed (leader side) --
+
+    def tail_since(self, after_seq: int, limit: int = 512
+                   ) -> list[tuple[int, str]] | None:
+        """Records with seq > ``after_seq`` from the in-memory buffer,
+        or None when the cursor fell off the buffer (or points past our
+        history — a diverged follower): the caller must resync from a
+        snapshot."""
+        if after_seq > self.seq:
+            return None
+        floor = self._tail[0][0] if self._tail else self.seq + 1
+        if after_seq + 1 < floor:
+            return None
+        out = [(s, line) for s, line in self._tail if s > after_seq]
+        return out[:limit] if limit else out
+
+    # -- segment rotation --
+
+    def rotate(self) -> int:
+        """Seal the active file into a ``.seg.<lastseq>`` segment and
+        start a fresh one.  Returns the sealed-through seq.  No-op on an
+        empty active file."""
+        self._fh.flush()
+        if self._fh.tell() == 0:
+            return self.seq
+        self._fh.close()
+        sealed = f"{self.path}.seg.{self.seq:016d}"
+        os.replace(self.path, sealed)
+        _fsync_dir(self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        return self.seq
+
+    def prune_segments(self, upto_seq: int) -> int:
+        """Delete sealed segments fully covered by a durable snapshot
+        (last seq <= ``upto_seq``).  Returns #segments removed."""
+        n = 0
+        for f in _segment_files(self.path):
+            try:
+                last = int(f.rsplit(".", 1)[1])
+            except ValueError:
+                continue
+            if last <= upto_seq:
+                try:
+                    os.unlink(f)
+                except FileNotFoundError:
+                    continue  # a concurrent compact absorbed it
+                n += 1
+        if n:
+            _fsync_dir(self.path)
+        return n
 
     # -- the lifecycle hooks the scheduler calls --
 
@@ -246,38 +357,89 @@ class WriteAheadLog:
     # -- recovery --
 
     @staticmethod
-    def replay(path: str) -> dict[int, tuple[str, Job]]:
-        """Last-writer-wins replay: job_id -> (last event, job record)."""
+    def replay(path: str, after_seq: int = 0
+               ) -> dict[int, tuple[str, Job]]:
+        """Last-writer-wins replay: job_id -> (last event, job record).
+
+        Reads sealed segments (oldest first) then the active file.
+        ``after_seq`` skips records a snapshot already covers (records
+        predating the seq field count as seq 0 and are only applied on a
+        full replay)."""
         state: dict[int, tuple[str, Job]] = {}
-        if not os.path.exists(path):
-            return state
-        with open(path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail write from a crash
-                job = _job_from_dict(rec["job"])
-                state[job.job_id] = (rec["ev"], job)
+        for rec in WriteAheadLog._iter_records(path):
+            if after_seq and rec.get("seq", 0) <= after_seq:
+                continue
+            job = _job_from_dict(rec["job"])
+            state[job.job_id] = (rec["ev"], job)
         return state
+
+    @staticmethod
+    def _iter_records(path: str):
+        for f in _segment_files(path) + [path]:
+            if not os.path.exists(f):
+                continue
+            with open(f, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write from a crash
 
     def compact(self, live: dict[int, tuple[str, Job]] | None = None
                 ) -> None:
         """Rewrite the log keeping only non-terminal jobs (the purge the
-        reference does after archiving to MongoDB)."""
-        live = live if live is not None else self.replay(self.path)
-        self._fh.close()
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as out:
+        reference does after archiving to MongoDB).
+
+        Crash-safe: the survivors are written to a temp file, fsync'd,
+        atomically renamed over the active file, and the directory entry
+        itself fsync'd — a kill at any point leaves either the old log
+        (plus an ignorable ``.tmp``) or the complete new one.  Sealed
+        segments are absorbed into the rewrite and deleted.
+
+        With sealed segments present the rewrite keeps every job's LAST
+        record — terminal tombstones included.  Dropping a terminal job
+        while its older (non-terminal) records still sit in a segment
+        would resurrect it as RUNNING if the process dies between the
+        active-file rename and the segment unlink (replay reads segments
+        first and nothing in the new active file would supersede them).
+        The tombstones fall out on the next segment-free compact."""
+        segments = _segment_files(self.path)
+        keep: list[tuple[int, str]] = []
+        if live is not None and not segments:
             for job_id, (ev, job) in sorted(live.items()):
                 if job.status.is_terminal:
                     continue
-                out.write(json.dumps({"ev": ev, "job": _job_to_dict(job)},
-                                     separators=(",", ":")) + "\n")
+                keep.append((job_id, json.dumps(
+                    {"seq": self.seq, "ev": ev, "job": _job_to_dict(job)},
+                    separators=(",", ":"))))
+        else:
+            # re-read raw records so each survivor keeps its original
+            # seq (follower cursors and segment ordering stay valid)
+            last: dict[int, tuple[int, dict]] = {}
+            for rec in self._iter_records(self.path):
+                last[rec["job"]["job_id"]] = (rec.get("seq", 0), rec)
+            for job_id, (seq, rec) in sorted(last.items()):
+                if not segments and \
+                        JobStatus[rec["job"]["status"]].is_terminal:
+                    continue
+                keep.append((job_id, json.dumps(
+                    rec, separators=(",", ":"))))
+        self._fh.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as out:
+            for _job_id, line in keep:
+                out.write(line + "\n")
             out.flush()
             os.fsync(out.fileno())
         os.replace(tmp, self.path)
+        _fsync_dir(self.path)
+        for f in segments:
+            try:
+                os.unlink(f)
+            except FileNotFoundError:
+                pass  # a concurrent prune got it first
+        _fsync_dir(self.path)
         self._fh = open(self.path, "a", encoding="utf-8")

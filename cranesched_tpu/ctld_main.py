@@ -49,7 +49,20 @@ def main(argv=None) -> int:
     ap.add_argument("--log-file", default="",
                     help="rotating log file (32 MiB x 5 by default)")
     ap.add_argument("--log-level", default="info")
+    ap.add_argument("--ha-standby", action="store_true",
+                    help="start as a hot standby: replicate from "
+                         "--ha-peer, serve queries only, and promote "
+                         "when the leader's lease frees")
+    ap.add_argument("--ha-peer", default="",
+                    help="the other ctld's address (the leader to "
+                         "replicate from when --ha-standby; advertised "
+                         "to redirected clients otherwise)")
+    ap.add_argument("--snapshot-interval", type=float, default=60.0,
+                    help="seconds between WAL snapshots (leader only; "
+                         "0 disables)")
     args = ap.parse_args(argv)
+    if args.ha_standby and not args.ha_peer:
+        ap.error("--ha-standby requires --ha-peer")
 
     from cranesched_tpu.utils.logging import setup_logging
     log = setup_logging("ctld", args.log_file, args.log_level)
@@ -78,17 +91,46 @@ def main(argv=None) -> int:
         print(f"history archive: {cfg.archive_path} "
               f"({scheduler.archive.count()} jobs)", flush=True)
 
-    # recovery before serving (reference JobScheduler::Init)
+    # recovery before serving (reference JobScheduler::Init).  A leader
+    # takes the WAL-dir lease FIRST: a second ctld pointed at the same
+    # WAL (operator error, or a fenced-off old leader restarting) fails
+    # fast instead of corrupting the log (VERDICT row 43).  A standby
+    # skips all of this — its follower thread seeds from its own local
+    # snapshot+WAL and only opens them for writing at promotion.
+    lease = None
     if cfg.wal_path:
+        # both roles write under the WAL dir (the standby keeps its
+        # replicated WAL, snapshot, and observed epoch there)
         os.makedirs(os.path.dirname(cfg.wal_path) or ".", exist_ok=True)
-        replayed = WriteAheadLog.replay(cfg.wal_path)
-        if replayed:
-            if args.sim:
-                for node in meta.nodes.values():
-                    node.alive = True
-            scheduler.recover(replayed, now=time.time())
-            print(f"recovered {len(replayed)} jobs from {cfg.wal_path}")
+    if cfg.wal_path and not args.ha_standby:
+        from cranesched_tpu.ha import LeaderLease
+        from cranesched_tpu.ha.snapshot import recover_from_snapshot
+        from cranesched_tpu.utils.filelock import FileLockHeld
+        lease = LeaderLease(cfg.wal_path)
+        try:
+            epoch = lease.acquire()
+        except FileLockHeld:
+            print(f"FATAL: another ctld holds the lease on "
+                  f"{cfg.wal_path} (is a leader already running?); "
+                  f"start this one with --ha-standby to follow it",
+                  file=sys.stderr, flush=True)
+            return 1
+        scheduler.fencing_epoch = epoch
+        if args.sim:
+            for node in meta.nodes.values():
+                node.alive = True
+        count, snap_seq = recover_from_snapshot(
+            scheduler, WriteAheadLog, cfg.wal_path, now=time.time())
+        # stderr: the first STDOUT line stays the "listening on port"
+        # banner (wrappers parse the bound port out of it)
+        if count:
+            print(f"recovered {count} jobs from {cfg.wal_path}"
+                  + (f" (snapshot @seq={snap_seq} + tail)"
+                     if snap_seq else ""),
+                  file=sys.stderr, flush=True)
         scheduler.wal = WriteAheadLog(cfg.wal_path)
+        print(f"leader lease acquired (fencing epoch {epoch})",
+              file=sys.stderr, flush=True)
 
     sim = None
     dispatcher = None
@@ -126,14 +168,47 @@ def main(argv=None) -> int:
     server, port = serve(scheduler, sim=sim, address=address,
                          cycle_interval=args.cycle_interval,
                          dispatcher=dispatcher, auth=auth, tls=tls,
-                         metrics_port=metrics_port)
+                         metrics_port=metrics_port,
+                         standby=args.ha_standby,
+                         peer_address=args.ha_peer)
     print(f"cranectld [{cfg.cluster_name}] listening on port {port} "
           f"({'simulated' if args.sim else 'real'} node plane, "
           f"{len(meta.nodes)} nodes configured"
-          f"{', TLS' if tls else ''})", flush=True)
+          f"{', TLS' if tls else ''}"
+          f"{', STANDBY of ' + args.ha_peer if args.ha_standby else ''}"
+          ")", flush=True)
     if server.metrics_port is not None:
         print(f"metrics: http://0.0.0.0:{server.metrics_port}/metrics",
               flush=True)
+
+    # HA plumbing needs the server lock, so it starts after serve()
+    snapshotter = None
+    follower = None
+    if cfg.wal_path:
+        from cranesched_tpu import ha as _ha
+
+        def _start_snapshotter():
+            nonlocal snapshotter
+            if args.snapshot_interval <= 0:
+                return
+            snapshotter = _ha.Snapshotter(
+                scheduler, scheduler.wal, server._lock, cfg.wal_path,
+                interval=args.snapshot_interval)
+            snapshotter.start()
+
+        if args.ha_standby:
+            follower = _ha.HaFollower(
+                server, args.ha_peer, cfg.wal_path,
+                token=(auth.craned_token if auth is not None else ""),
+                tls=tls.for_client() if tls else None,
+                on_promote=lambda epoch: _start_snapshotter())
+            server.ha_follower = follower
+            follower.start()
+            print(f"hot standby: replicating from {args.ha_peer}",
+                  flush=True)
+        else:
+            _ha.ROLE_GAUGE.set(1)
+            _start_snapshotter()
 
     syncer = None
     if cfg.license_sync.get("Program"):
@@ -153,9 +228,15 @@ def main(argv=None) -> int:
     stop.wait()
     if syncer is not None:
         syncer.stop()
+    if follower is not None:
+        follower.stop()
+    if snapshotter is not None:
+        snapshotter.stop()
     server.stop()
     if dispatcher is not None:
         dispatcher.close()
+    if lease is not None:
+        lease.release()
     return 0
 
 

@@ -1,7 +1,16 @@
 """Client stub for the CraneCtld service (hand-glued; used by the CLI
-and by node daemons)."""
+and by node daemons).
+
+:class:`HaCtldClient` wraps one :class:`CtldClient` per configured ctld
+address and retries leader-affine: a call that fails with UNAVAILABLE
+(endpoint dead) or FAILED_PRECONDITION (a standby's not-leader refusal)
+rotates to the next address, so cbatch/cqueue keep working across a
+failover without the caller knowing which ctld currently leads.
+"""
 
 from __future__ import annotations
+
+import grpc
 
 from cranesched_tpu.rpc import crane_pb2 as pb
 from cranesched_tpu.rpc.consts import SERVICE
@@ -210,3 +219,150 @@ class CtldClient:
 
     def tick(self, now: float) -> pb.TickReply:
         return self._call("Tick", pb.TickRequest(now=now), pb.TickReply)
+
+    # ---- HA + summary ----
+
+    def requeue(self, job_id: int) -> pb.OkReply:
+        return self._call("RequeueJob", pb.JobIdRequest(job_id=job_id),
+                          pb.OkReply)
+
+    def query_job_summary(self, user: str = "", partition: str = ""
+                          ) -> pb.QueryJobSummaryReply:
+        return self._call(
+            "QueryJobSummary",
+            pb.QueryJobSummaryRequest(user=user, partition=partition),
+            pb.QueryJobSummaryReply)
+
+    def ha_status(self) -> pb.HaStatusReply:
+        return self._call("HaStatus", pb.HaStatusRequest(),
+                          pb.HaStatusReply)
+
+    def ha_fetch_snapshot(self) -> pb.HaSnapshotReply:
+        return self._call("HaFetchSnapshot", pb.HaSnapshotRequest(),
+                          pb.HaSnapshotReply)
+
+    def ha_fetch_wal(self, after_seq: int,
+                     limit: int = 0) -> pb.HaFetchReply:
+        return self._call("HaFetchWal",
+                          pb.HaFetchRequest(after_seq=after_seq,
+                                            limit=limit),
+                          pb.HaFetchReply)
+
+
+# gRPC codes that mean "try the next ctld": the endpoint is down/
+# unreachable, or it answered but refused as a standby
+_ROTATE_CODES = (grpc.StatusCode.UNAVAILABLE,
+                 grpc.StatusCode.FAILED_PRECONDITION,
+                 grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
+class HaCtldClient(CtldClient):
+    """Leader-finding client over a list of ctld addresses.
+
+    Shares :class:`CtldClient`'s full method surface — only ``_call``
+    (and the stream dial) differ: the sticky index remembers the last
+    address that answered as leader, and every failure in
+    ``_ROTATE_CODES`` advances it.  One full rotation without an answer
+    re-raises the last error.
+    """
+
+    def __init__(self, addresses, timeout: float = 30.0,
+                 token: str = "", tls=None):
+        if isinstance(addresses, str):
+            addresses = [a.strip() for a in addresses.split(",")
+                         if a.strip()]
+        if not addresses:
+            raise ValueError("HaCtldClient needs at least one address")
+        self.addresses = list(addresses)
+        self.timeout = timeout
+        self._token = token
+        self._tls = tls
+        self._idx = 0
+        self._clients: dict[int, CtldClient] = {}
+        # CtldClient API compat (tests introspect .address/._stub)
+        self.address = self.addresses[0]
+
+    def _at(self, idx: int) -> CtldClient:
+        cli = self._clients.get(idx)
+        if cli is None:
+            cli = CtldClient(self.addresses[idx], timeout=self.timeout,
+                             token=self._token, tls=self._tls)
+            self._clients[idx] = cli
+        return cli
+
+    @property
+    def _stub(self):
+        return self._at(self._idx)._stub
+
+    def close(self) -> None:
+        for cli in self._clients.values():
+            cli.close()
+        self._clients.clear()
+
+    def _call(self, name, request, reply_cls):
+        last_err = None
+        for attempt in range(len(self.addresses)):
+            idx = (self._idx + attempt) % len(self.addresses)
+            try:
+                reply = self._at(idx)._call(name, request, reply_cls)
+            except grpc.RpcError as e:
+                if e.code() not in _ROTATE_CODES:
+                    raise
+                last_err = e
+                # drop the dead channel so a later retry re-dials
+                cli = self._clients.pop(idx, None)
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:
+                        pass
+                continue
+            self._idx = idx
+            self.address = self.addresses[idx]
+            return reply
+        raise last_err
+
+    def query_jobs_stream(self, *args, **kwargs):
+        """The streaming query dials ``self._stub`` directly, so it
+        needs its own rotation: a stream that dies BEFORE yielding a
+        row advances to the next address (cqueue right after a
+        failover); one that dies mid-stream re-raises — the caller
+        must not see a silently restarted (duplicated) listing."""
+        last_err = None
+        for attempt in range(len(self.addresses)):
+            idx = (self._idx + attempt) % len(self.addresses)
+            yielded = False
+            try:
+                for item in self._at(idx).query_jobs_stream(*args,
+                                                            **kwargs):
+                    yielded = True
+                    yield item
+            except grpc.RpcError as e:
+                if yielded or e.code() not in _ROTATE_CODES:
+                    raise
+                last_err = e
+                cli = self._clients.pop(idx, None)
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:
+                        pass
+                continue
+            self._idx = idx
+            self.address = self.addresses[idx]
+            return
+        raise last_err
+
+
+def make_client(addresses, timeout: float = 30.0, token: str = "",
+                tls=None) -> CtldClient:
+    """One address -> plain client; a comma-separated list (or an
+    actual list) -> failover-aware :class:`HaCtldClient`."""
+    if isinstance(addresses, str):
+        parts = [a.strip() for a in addresses.split(",") if a.strip()]
+    else:
+        parts = list(addresses)
+    if len(parts) == 1:
+        return CtldClient(parts[0], timeout=timeout, token=token,
+                          tls=tls)
+    return HaCtldClient(parts, timeout=timeout, token=token, tls=tls)

@@ -112,6 +112,11 @@ class GrpcDispatcher:
         # stamped with the job's *current* requeue_count would defeat the
         # staleness guard and kill the healthy new incarnation
         incarnation = job.requeue_count
+        # same capture discipline for the fencing epoch: a push built
+        # after this ctld lost the lease must carry the OLD epoch so the
+        # craned (which learned the new one from the promoted standby)
+        # rejects it
+        epoch = self.scheduler.fencing_epoch
 
         def push(node_id, ntasks):
             stub = self._stub(node_id)
@@ -124,6 +129,7 @@ class GrpcDispatcher:
                     job_id=job.job_id, spec=spec_pb,
                     tasks_on_node=ntasks, now=time.time(),
                     incarnation=incarnation, step_id=0,
+                    fencing_epoch=epoch,
                     nodelist=gang["nodelist"],
                     node_rank=gang["rank"][node_id],
                     nnodes=len(node_ids),
@@ -159,7 +165,8 @@ class GrpcDispatcher:
                 for node_id in node_ids:
                     self._try_call(node_id, undo,
                                    pb.JobIdRequest(job_id=job.job_id,
-                                                   incarnation=incarnation))
+                                                   incarnation=incarnation,
+                                                   fencing_epoch=epoch))
                 self.scheduler.step_status_change(
                     job.job_id, JobStatus.FAILED, 254, time.time(),
                     incarnation=incarnation)
@@ -209,6 +216,7 @@ class GrpcDispatcher:
         spec_pb = spec_to_pb(job.spec)
         step_pb = step_spec_to_pb(step.spec)
         incarnation = job.requeue_count
+        epoch = self.scheduler.fencing_epoch
         node_ids = list(step.node_ids)
         step_id = step.step_id
         gang = self._gang_ctx(job.job_id, node_ids, len(node_ids),
@@ -225,7 +233,7 @@ class GrpcDispatcher:
                 req = pb.ExecuteStepRequest(
                     job_id=job.job_id, spec=spec_pb, tasks_on_node=1,
                     now=time.time(), incarnation=incarnation,
-                    step_id=step_id,
+                    step_id=step_id, fencing_epoch=epoch,
                     nodelist=gang["nodelist"],
                     node_rank=gang["rank"][node_id],
                     nnodes=len(node_ids),
@@ -245,7 +253,8 @@ class GrpcDispatcher:
                     self._try_call(node_id, "TerminateStep",
                                    pb.JobIdRequest(job_id=job.job_id,
                                                    step_id=step_id,
-                                                   incarnation=incarnation))
+                                                   incarnation=incarnation,
+                                                   fencing_epoch=epoch))
                 # enqueue, never mutate: this runs on a pool thread
                 # without the server lock (step_report would race the
                 # cycle thread's _try_start_steps and WAL writes)
@@ -263,10 +272,12 @@ class GrpcDispatcher:
         step = job.steps.get(step_id)
         nodes = list(step.node_ids) if step is not None else []
         incarnation = job.requeue_count
+        epoch = self.scheduler.fencing_epoch
         self._pool.submit(lambda: [
             self._try_call(n, "TerminateStep",
                            pb.JobIdRequest(job_id=job_id, step_id=step_id,
-                                           incarnation=incarnation))
+                                           incarnation=incarnation,
+                                           fencing_epoch=epoch))
             for n in nodes])
 
     def free_alloc(self, job_id: int, now: float,
@@ -274,9 +285,11 @@ class GrpcDispatcher:
                    skip_node: int | None = None) -> None:
         """Release the allocation on every node (FreeJob fan-out)."""
         nodes = [n for n in self._job_nodes(job_id) if n != skip_node]
-        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation)
+        epoch = self.scheduler.fencing_epoch
+        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation,
+                               fencing_epoch=epoch)
                if incarnation is not None
-               else pb.JobIdRequest(job_id=job_id))
+               else pb.JobIdRequest(job_id=job_id, fencing_epoch=epoch))
         self._pool.submit(lambda: [
             self._try_call(n, "FreeJob", req) for n in nodes])
 
@@ -284,24 +297,30 @@ class GrpcDispatcher:
                   incarnation: int | None = None,
                   skip_node: int | None = None) -> None:
         nodes = [n for n in self._job_nodes(job_id) if n != skip_node]
-        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation)
+        epoch = self.scheduler.fencing_epoch
+        req = (pb.JobIdRequest(job_id=job_id, incarnation=incarnation,
+                               fencing_epoch=epoch)
                if incarnation is not None
-               else pb.JobIdRequest(job_id=job_id))
+               else pb.JobIdRequest(job_id=job_id, fencing_epoch=epoch))
         self._pool.submit(lambda: [
             self._try_call(n, "TerminateStep", req) for n in nodes])
 
     def suspend(self, job_id: int, now: float) -> None:
         nodes = self._job_nodes(job_id)
+        epoch = self.scheduler.fencing_epoch
         self._pool.submit(lambda: [
             self._try_call(n, "SuspendStep",
-                           pb.JobIdRequest(job_id=job_id))
+                           pb.JobIdRequest(job_id=job_id,
+                                           fencing_epoch=epoch))
             for n in nodes])
 
     def resume(self, job_id: int, now: float) -> None:
         nodes = self._job_nodes(job_id)
+        epoch = self.scheduler.fencing_epoch
         self._pool.submit(lambda: [
             self._try_call(n, "ResumeStep",
-                           pb.JobIdRequest(job_id=job_id))
+                           pb.JobIdRequest(job_id=job_id,
+                                           fencing_epoch=epoch))
             for n in nodes])
 
     def change_time_limit(self, job_id: int, time_limit: float,
@@ -316,9 +335,11 @@ class GrpcDispatcher:
             return
         nodes = list(job.node_ids)
         incarnation = job.requeue_count
+        epoch = self.scheduler.fencing_epoch
         request = pb.TimeLimitRequest(job_id=job_id,
                                       time_limit=time_limit,
-                                      incarnation=incarnation)
+                                      incarnation=incarnation,
+                                      fencing_epoch=epoch)
 
         def push():
             all_ok = True

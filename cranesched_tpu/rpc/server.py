@@ -60,7 +60,8 @@ class CtldServer:
                  sim: SimCluster | None = None,
                  cycle_interval: float = 1.0, tick_mode: bool = False,
                  dispatcher=None, auth=None, tls=None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 standby: bool = False, peer_address: str = ""):
         self.scheduler = scheduler
         self.sim = sim
         # real node plane: per-node push stubs (wired into the
@@ -86,6 +87,14 @@ class CtldServer:
         self._server: grpc.Server | None = None
         self._cycle_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # HA: a standby serves the read surface from its shadow state
+        # and aborts mutations with FAILED_PRECONDITION so failover-
+        # aware clients (HaCtldClient, craned's address rotation) move
+        # on; promote_to_leader() flips the role and the cycle-loop gate
+        self.ha_role = "standby" if standby else "leader"
+        self.ha_peer = peer_address  # the other ctld (redirect hint)
+        self.ha_follower = None      # set by ctld_main on a standby
+        self.failovers = 0
 
     # ---- authentication helpers ----
 
@@ -547,6 +556,21 @@ class CtldServer:
                     self.scheduler.stats.get("cycle_crashes_total", 0),
                 "last_crash": self.scheduler.stats.get("last_crash"),
             }
+            wal = self.scheduler.wal
+            lag = 0
+            if self.ha_follower is not None:
+                lag = max(0, self.ha_follower.leader_seq
+                          - self.ha_follower.applied_seq)
+            doc["ha"] = {
+                "role": self.ha_role,
+                "fencing_epoch": self.scheduler.fencing_epoch,
+                "wal_seq": (self.ha_follower.applied_seq
+                            if self.ha_follower is not None
+                            else (wal.seq if wal is not None else 0)),
+                "replication_lag": lag,
+                "failovers_total": self.failovers,
+                "peer": self.ha_peer,
+            }
             return pb.StatsReply(json=_json.dumps(doc))
 
     def AcctMgr(self, request, context):
@@ -737,8 +761,11 @@ class CtldServer:
             expected = [jid for jid, job in
                         self.scheduler.running.items()
                         if node.node_id in job.node_ids]
-            return pb.CranedRegisterReply(ok=True, node_id=node.node_id,
-                                          expected_jobs=expected)
+            # the craned latches this epoch and fences lower-epoch
+            # pushes — the deposed leader's in-flight RPCs die here
+            return pb.CranedRegisterReply(
+                ok=True, node_id=node.node_id, expected_jobs=expected,
+                fencing_epoch=self.scheduler.fencing_epoch)
 
     def CranedPing(self, request, context):
         deny = self._deny_internal(self._ident(context),
@@ -793,6 +820,100 @@ class CtldServer:
             started = self.scheduler.schedule_cycle(request.now)
         return pb.TickReply(started=started, now=request.now)
 
+    # ---- HA + summary ----
+
+    def RequeueJob(self, request, context):
+        """Kill-and-repend a running job (reference RequeueJob,
+        Crane.proto:1407)."""
+        with self._lock:
+            deny = self._deny_job_mutation(self._ident(context),
+                                           request.job_id)
+            if deny:
+                return pb.OkReply(ok=False, error=deny)
+            err = self.scheduler.requeue(request.job_id,
+                                         now=self._now())
+        return pb.OkReply(ok=not err, error=err)
+
+    def QueryJobSummary(self, request, context):
+        """Per-status counts (reference QueryJobSummary,
+        Crane.proto:1588) — works on a standby too (shadow state)."""
+        self._require_authenticated(self._ident(context), context)
+        with self._lock:
+            counts = self.scheduler.job_summary(request.user,
+                                                request.partition)
+        reply = pb.QueryJobSummaryReply(total=sum(counts.values()))
+        for status in sorted(counts):
+            reply.states.add(status=status, count=counts[status])
+        return reply
+
+    def HaStatus(self, request, context):
+        self._require_authenticated(self._ident(context), context)
+        with self._lock:
+            wal = self.scheduler.wal
+            seq = wal.seq if wal is not None else 0
+            lag = 0
+            leader = "" if self.ha_role == "leader" else self.ha_peer
+            if self.ha_follower is not None:
+                seq = self.ha_follower.applied_seq
+                lag = max(0, self.ha_follower.leader_seq - seq)
+            return pb.HaStatusReply(
+                role=self.ha_role,
+                fencing_epoch=self.scheduler.fencing_epoch,
+                wal_seq=seq, leader_address=leader,
+                replication_lag=lag)
+
+    def HaFetchSnapshot(self, request, context):
+        """Serve a point-in-time snapshot to a syncing standby."""
+        self._require_authenticated(self._ident(context), context)
+        import json as _json
+
+        from cranesched_tpu.ha.snapshot import capture_snapshot
+        with self._lock:
+            doc = capture_snapshot(self.scheduler)
+            epoch = self.scheduler.fencing_epoch
+        return pb.HaSnapshotReply(ok=True, seq=doc["seq"],
+                                  payload=_json.dumps(
+                                      doc, separators=(",", ":")),
+                                  fencing_epoch=epoch)
+
+    def HaFetchWal(self, request, context):
+        """Cursor-based WAL tail for the polling standby."""
+        self._require_authenticated(self._ident(context), context)
+        with self._lock:
+            wal = self.scheduler.wal
+            if wal is None:
+                return pb.HaFetchReply(ok=False,
+                                       error="no WAL on this ctld")
+            out = wal.tail_since(request.after_seq,
+                                 limit=request.limit or 512)
+            seq = wal.seq
+            epoch = self.scheduler.fencing_epoch
+        reply = pb.HaFetchReply(ok=True, wal_seq=seq,
+                                fencing_epoch=epoch)
+        if out is None:
+            reply.resync = True
+        else:
+            for s, line in out:
+                reply.records.add(seq=s, payload=line)
+        return reply
+
+    def promote_to_leader(self, epoch: int) -> None:
+        """Flip a standby to leader: the cycle-loop gate opens on the
+        next tick and the mutation surface starts answering.  The
+        scheduler-side rebuild (recover + device state + epoch) is the
+        follower's job BEFORE calling this."""
+        self.ha_role = "leader"
+        self.ha_follower = None
+        self.failovers += 1
+        # seed push channels from the replicated node addresses so a
+        # re-sent kill (recover's cancel-intent redelivery) can land
+        # BEFORE the craneds get around to re-registering
+        if self.dispatcher is not None:
+            for node in self.scheduler.meta.nodes.values():
+                if node.alive and node.address:
+                    self.dispatcher.node_registered(node.node_id,
+                                                    node.address)
+
     # ---- lifecycle ----
 
     _RPCS = {
@@ -822,17 +943,48 @@ class CtldServer:
         "CranedPing": (pb.CranedPingRequest, pb.OkReply),
         "StepStatusChange": (pb.StepStatusChangeRequest, pb.OkReply),
         "Tick": (pb.TickRequest, pb.TickReply),
+        "RequeueJob": (pb.JobIdRequest, pb.OkReply),
+        "QueryJobSummary": (pb.QueryJobSummaryRequest,
+                            pb.QueryJobSummaryReply),
+        "HaStatus": (pb.HaStatusRequest, pb.HaStatusReply),
+        "HaFetchSnapshot": (pb.HaSnapshotRequest, pb.HaSnapshotReply),
+        "HaFetchWal": (pb.HaFetchRequest, pb.HaFetchReply),
     }
+
+    # the surface a standby may serve from its shadow state; everything
+    # else aborts FAILED_PRECONDITION ("not leader") so failover-aware
+    # callers rotate to the leader.  Craned-internal RPCs are
+    # deliberately NOT here: craneds must register/report to the leader
+    # only, or the standby's shadow state would fork from the WAL.
+    _STANDBY_OK = frozenset({
+        "QueryJobsInfo", "QueryJobsStream", "QueryStepsInfo",
+        "QueryClusterInfo", "QueryStats", "QueryJobSummary", "HaStatus",
+    })
 
     def _now(self) -> float:
         return self.sim.now if (self.tick_mode and self.sim is not None) \
             else time.time()
 
+    def _leader_only(self, name, fn):
+        """Gate one handler on leadership.  The abort code is part of
+        the failover contract: HaCtldClient and the craned's ctld
+        address rotation both treat FAILED_PRECONDITION as 'ask the
+        other ctld'."""
+        def handler(request, context):
+            if self.ha_role != "leader":
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"not leader (standby"
+                    f"{'; try ' + self.ha_peer if self.ha_peer else ''})")
+            return fn(request, context)
+        return handler
+
     def start(self, address: str = "127.0.0.1:0") -> int:
         """Start serving; returns the bound port."""
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                getattr(self, name),
+                (getattr(self, name) if name in self._STANDBY_OK
+                 else self._leader_only(name, getattr(self, name))),
                 request_deserializer=req.FromString,
                 response_serializer=reply.SerializeToString)
             for name, (req, reply) in self._RPCS.items()
@@ -886,6 +1038,8 @@ class CtldServer:
         closed, and the NEXT tick schedules normally (fault-injection
         test: tests/test_obs.py)."""
         while not self._stop.wait(self.cycle_interval):
+            if self.ha_role != "leader":
+                continue  # standby: shadow state only, never schedule
             now = time.time()
             try:
                 self._cycle_once(now)

@@ -1,12 +1,15 @@
-"""Shared host utilities: hostlist grammar, config loading, and the
-ctypes bridge to the native C++ library (native/crane_native.cpp)."""
+"""Shared host utilities: hostlist grammar, config loading, file
+locking, and the ctypes bridge to the native C++ library
+(native/crane_native.cpp)."""
 
+from cranesched_tpu.utils.filelock import FileLock, FileLockHeld
 from cranesched_tpu.utils.hostlist import (
     compress_hostlist,
     parse_hostlist,
 )
 
-__all__ = ["compress_hostlist", "parse_hostlist", "load_config"]
+__all__ = ["compress_hostlist", "parse_hostlist", "load_config",
+           "FileLock", "FileLockHeld"]
 
 
 def __getattr__(name):

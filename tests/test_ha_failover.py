@@ -1,0 +1,504 @@
+"""HA subsystem: leader lease + fencing, snapshot/WAL replication, and
+hot-standby failover (reference the ctld HA design around
+CtldGrpcServer.h:568 + EmbeddedDbClient.h:85-204).
+
+Fast unit tests are unmarked; the end-to-end failover drill (real
+craneds, real subprocess steps, three leadership flips) is marked
+``slow`` + ``ha`` and runs in the ``make tier1-ha`` lane.
+"""
+
+import collections
+import socket
+import threading
+import time
+
+import grpc
+import pytest
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.ha.follower import HaFollower
+from cranesched_tpu.ha.lease import FencingEpoch, LeaderLease
+from cranesched_tpu.ha.snapshot import (
+    SnapshotStore,
+    Snapshotter,
+    recover_from_snapshot,
+)
+from cranesched_tpu.rpc import crane_pb2 as pb, serve
+from cranesched_tpu.rpc.client import CtldClient, HaCtldClient, make_client
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+from cranesched_tpu.utils.filelock import FileLock, FileLockHeld
+
+
+# ---------------------------------------------------------------------------
+# lease + fencing epoch
+# ---------------------------------------------------------------------------
+
+def test_filelock_mutual_exclusion(tmp_path):
+    path = str(tmp_path / "wal.lock")
+    a, b = FileLock(path), FileLock(path)
+    a.acquire()
+    assert a.held
+    with pytest.raises(FileLockHeld):
+        b.acquire()
+    a.release()
+    assert not a.held
+    with b:
+        assert b.held
+    assert not b.held
+
+
+def test_second_ctld_on_same_wal_fails_fast(tmp_path):
+    """VERDICT row 43: two ctlds pointed at the same WAL must not both
+    come up — the second acquisition fails immediately (ctld_main turns
+    this into a fatal startup error)."""
+    wal = str(tmp_path / "ctld.wal")
+    first = LeaderLease(wal)
+    assert first.acquire() == 1
+    with pytest.raises(FileLockHeld):
+        LeaderLease(wal).acquire()
+    first.release()
+    # the lock dying with its holder starts the next term
+    assert LeaderLease(wal).acquire() == 2
+
+
+def test_fencing_epoch_monotonic_and_observed(tmp_path):
+    wal = str(tmp_path / "ctld.wal")
+    lease = LeaderLease(wal)
+    assert lease.acquire() == 1
+    lease.release()
+    assert lease.acquire() == 2
+    lease.release()
+    # a standby that replicated epoch 41 from a remote leader (separate
+    # WAL dir, so separate epoch files) must still promote PAST it
+    FencingEpoch(wal).observe(41)
+    assert lease.acquire() == 42
+    lease.release()
+    # observe never regresses the counter
+    FencingEpoch(wal).observe(5)
+    assert FencingEpoch(wal).load() == 42
+
+
+def _craned(tmp_path, name="fn00"):
+    return CranedDaemon(name, "127.0.0.1:1", cpu=4.0, mem_bytes=4 << 30,
+                        workdir=str(tmp_path),
+                        cgroup_root=str(tmp_path / "nocgroup"))
+
+
+def test_craned_latches_and_fences_epochs(tmp_path):
+    d = _craned(tmp_path)
+    # epoch 0 = HA not configured: no check, nothing latched
+    assert d.TerminateStep(pb.JobIdRequest(job_id=1), None).ok
+    assert d._fencing_epoch == 0
+    # any push teaches the daemon the current term
+    d.TerminateStep(pb.JobIdRequest(job_id=1, fencing_epoch=5), None)
+    assert d._fencing_epoch == 5
+    # every order verb rejects a stale term
+    stale = [
+        d.AllocJob(pb.ExecuteStepRequest(job_id=2, fencing_epoch=4),
+                   None),
+        d.ExecuteStep(pb.ExecuteStepRequest(job_id=2, fencing_epoch=4),
+                      None),
+        d.TerminateStep(pb.JobIdRequest(job_id=2, fencing_epoch=4),
+                        None),
+        d.FreeJob(pb.JobIdRequest(job_id=2, fencing_epoch=4), None),
+        d.SuspendStep(pb.JobIdRequest(job_id=2, fencing_epoch=4), None),
+        d.ResumeStep(pb.JobIdRequest(job_id=2, fencing_epoch=4), None),
+        d.ChangeTimeLimit(
+            pb.TimeLimitRequest(job_id=2, time_limit=9.0,
+                                fencing_epoch=4), None),
+    ]
+    for rep in stale:
+        assert not rep.ok and "fenced" in rep.error
+    # a newer term latches upward; the old one is now fenced
+    d.TerminateStep(pb.JobIdRequest(job_id=3, fencing_epoch=7), None)
+    assert d._fencing_epoch == 7
+    rep = d.TerminateStep(pb.JobIdRequest(job_id=3, fencing_epoch=5),
+                          None)
+    assert not rep.ok and "fenced" in rep.error
+
+
+# ---------------------------------------------------------------------------
+# snapshot + recovery
+# ---------------------------------------------------------------------------
+
+def _sim_build(num_nodes=3, wal=None):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(priority_type="basic"),
+                         wal=wal)
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+    return meta, sched, cluster
+
+
+def _spec(cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+def test_snapshot_plus_tail_recovery(tmp_path):
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = _sim_build(wal=wal)
+    done = sched.submit(_spec(cpu=2.0, runtime=5.0), now=0.0)
+    run = sched.submit(_spec(cpu=8.0, runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(6.0)
+    sched.process_status_changes()
+    run_nodes = sched.job_info(run).node_ids
+
+    snapper = Snapshotter(sched, wal, threading.Lock(), path,
+                          interval=3600.0)
+    seq = snapper.snap_once()
+    assert seq > 0
+    assert SnapshotStore(path).load()["seq"] == seq
+    # nothing new since the last snapshot -> skipped
+    assert snapper.snap_once() == 0
+
+    # tail records past the snapshot
+    tail = sched.submit(_spec(cpu=8.0, runtime=10.0), now=7.0)
+    wal.close()
+
+    # ---- crash: snapshot + tail rebuild everything ----
+    meta2, sched2, _ = _sim_build()
+    count, snap_seq = recover_from_snapshot(sched2, WriteAheadLog, path,
+                                            now=8.0)
+    assert (count, snap_seq) == (3, seq)
+    assert sched2.job_info(done).status == JobStatus.COMPLETED
+    assert sched2.job_info(run).status == JobStatus.RUNNING
+    assert sched2.job_info(run).node_ids == run_nodes
+    assert tail in sched2.pending
+    # the ledger re-applied and the id sequence continues
+    for n in run_nodes:
+        assert meta2.nodes[n].avail[0] < meta2.nodes[n].total[0]
+    assert sched2.submit(_spec(), now=9.0) == tail + 1
+
+
+# ---------------------------------------------------------------------------
+# standby read surface + client rotation
+# ---------------------------------------------------------------------------
+
+def _pb_spec(cpu=1.0, runtime=30.0, **kw):
+    return pb.JobSpec(res=pb.ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                          memsw_bytes=1 << 30),
+                      sim_runtime=runtime, **kw)
+
+
+def test_standby_refuses_mutations_serves_queries(tmp_path):
+    _, sched1, _ = _sim_build()
+    leader, lport = serve(sched1, tick_mode=True)
+    _, sched2, _ = _sim_build()
+    standby, sport = serve(sched2, tick_mode=True, standby=True,
+                           peer_address=f"127.0.0.1:{lport}")
+    direct = ha = None
+    try:
+        direct = CtldClient(f"127.0.0.1:{sport}")
+        with pytest.raises(grpc.RpcError) as ei:
+            direct.submit(_pb_spec())
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "not leader" in (ei.value.details() or "")
+        # the read surface still answers from the shadow state
+        assert list(direct.query_jobs().jobs) == []
+        st = direct.ha_status()
+        assert st.role == "standby"
+        assert st.leader_address.endswith(str(lport))
+        # a failover-aware client rotates off the standby transparently
+        ha = HaCtldClient([f"127.0.0.1:{sport}", f"127.0.0.1:{lport}"])
+        jid = ha.submit(_pb_spec()).job_id
+        assert jid == 1 and sched1.job_info(jid) is not None
+        assert ha.ha_status().role == "leader"
+        # the streaming query rotates off a dead address too (cqueue
+        # right after a failover)
+        ha2 = HaCtldClient([f"127.0.0.1:{_free_port()}",
+                            f"127.0.0.1:{lport}"])
+        assert [j.job_id for j in ha2.query_jobs_stream()] == [jid]
+        ha2.close()
+    finally:
+        for c in (direct, ha):
+            if c is not None:
+                c.close()
+        standby.stop()
+        leader.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end failover drill (make tier1-ha)
+# ---------------------------------------------------------------------------
+
+NODES = ("hn00", "hn01")
+CYCLE = 0.2
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class _Ctld:
+    """One ctld of the HA pair on a FIXED port, so a restarted instance
+    keeps its address and the craneds' --ctld list never changes.  Both
+    ctlds pre-add the same node list in the same order, so node ids
+    agree across the pair (deployments share a config file)."""
+
+    def __init__(self, name, tmp_path, port, peer_port=None,
+                 standby=False):
+        self.name = name
+        self.port = port
+        self.wal_path = str(tmp_path / f"{name}.wal")
+        meta = MetaContainer()
+        for n in NODES:
+            meta.add_node(n, meta.layout.encode(cpu=4,
+                                                mem_bytes=8 << 30,
+                                                memsw_bytes=8 << 30,
+                                                is_capacity=True))
+        self.sched = JobScheduler(meta, SchedulerConfig(
+            backfill=False, craned_timeout=5.0))
+        self.dispatcher = GrpcDispatcher(self.sched)
+        self.dispatcher.wire(self.sched)
+        self.lease = None
+        self.follower = None
+        if not standby:
+            self.lease = LeaderLease(self.wal_path)
+            self.sched.fencing_epoch = self.lease.acquire()
+            recover_from_snapshot(self.sched, WriteAheadLog,
+                                  self.wal_path, now=time.time())
+            self.sched.wal = WriteAheadLog(self.wal_path)
+        self.server, bound = serve(
+            self.sched, address=f"127.0.0.1:{port}",
+            cycle_interval=CYCLE, dispatcher=self.dispatcher,
+            standby=standby,
+            peer_address=(f"127.0.0.1:{peer_port}" if peer_port
+                          else ""))
+        if bound != port:
+            self.server.stop()
+            raise RuntimeError(f"could not bind {port}")
+        if standby:
+            self.follower = HaFollower(
+                self.server, f"127.0.0.1:{peer_port}", self.wal_path,
+                poll_interval=0.15, miss_threshold=3)
+            self.server.ha_follower = self.follower
+            self.follower.start()
+
+    @property
+    def epoch(self):
+        return self.sched.fencing_epoch
+
+    @property
+    def promoted(self):
+        return (self.follower is not None
+                and self.follower.promoted.is_set())
+
+    def kill(self):
+        """SIGKILL analog: stop answering, drop the flock (an OS lock
+        dies with its holder), leave the WAL/snapshot files as-is."""
+        if self.follower is not None:
+            self.follower.stop()
+        self.server.stop()
+        self.dispatcher.close()
+        lease = self.lease or (self.follower.lease
+                               if self.follower is not None else None)
+        if lease is not None and lease.held:
+            lease.release()
+        if self.sched.wal is not None:
+            self.sched.wal.close()
+
+
+def _start_standby(name, tmp_path, port, peer_port):
+    """The dead leader's port lingers in TIME_WAIT briefly — retry the
+    fixed-port bind instead of racing it."""
+    deadline = time.time() + 10.0
+    while True:
+        try:
+            return _Ctld(name, tmp_path, port, peer_port=peer_port,
+                         standby=True)
+        except RuntimeError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+@pytest.mark.slow
+@pytest.mark.ha
+def test_failover_e2e_three_flips(tmp_path, monkeypatch):
+    # count ACCEPTED dispatches per (node, job, incarnation): the same
+    # incarnation landing twice anywhere = a double-run
+    dispatches = collections.Counter()
+    real_exec = CranedDaemon.ExecuteStep
+
+    def counting_exec(self, request, context):
+        reply = real_exec(self, request, context)
+        if reply.ok:
+            dispatches[(self.name, request.job_id,
+                        request.incarnation)] += 1
+        return reply
+
+    monkeypatch.setattr(CranedDaemon, "ExecuteStep", counting_exec)
+
+    p1, p2 = _free_port(), _free_port()
+    ctld_list = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    leader = _Ctld("A", tmp_path, p1)
+    standby = _start_standby("B", tmp_path, p2, peer_port=p1)
+    ctlds = [leader, standby]
+    craneds = []
+    cli = make_client(ctld_list, timeout=5.0)
+
+    def status(jid):
+        try:
+            for j in cli.query_jobs(include_history=True).jobs:
+                if j.job_id == jid:
+                    return j.status
+        except grpc.RpcError:
+            pass
+        return None
+
+    def submit(script, out):
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                return cli.submit(pb.JobSpec(
+                    res=pb.ResourceSpec(cpu=1.0, mem_bytes=1 << 28,
+                                        memsw_bytes=1 << 28),
+                    script=script,
+                    output_path=str(tmp_path / out))).job_id
+            except grpc.RpcError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    try:
+        for n in NODES:
+            d = CranedDaemon(n, ctld_list, cpu=4.0, mem_bytes=8 << 30,
+                             workdir=str(tmp_path), ping_interval=0.4,
+                             cgroup_root=str(tmp_path / "nocgroup"))
+            d.start()
+            craneds.append(d)
+        assert wait_for(lambda: all(d.state == CranedState.READY
+                                    for d in craneds))
+        assert wait_for(lambda: all(
+            n.alive for n in leader.sched.meta.nodes.values()))
+
+        # two sleepers that must survive every flip + one pre-crash
+        # completion that must stay in history
+        long_ids = [submit("sleep 120", f"long{i}_%j.out")
+                    for i in range(2)]
+        short = submit("echo pre-crash", "short_%j.out")
+        assert wait_for(lambda: status(short) == "Completed")
+        assert wait_for(lambda: all(status(j) == "Running"
+                                    for j in long_ids))
+
+        for flip in range(3):
+            # the standby must have replicated everything first
+            assert wait_for(
+                lambda: standby.follower.applied_seq
+                >= leader.sched.wal.seq,
+                timeout=10.0), f"flip {flip}: standby never caught up"
+            pre = {j.job_id: j.status
+                   for j in cli.query_jobs(include_history=True).jobs}
+            old_epoch = leader.epoch
+            dead_name, dead_port = leader.name, leader.port
+            leader.kill()
+            t_kill = time.time()
+
+            assert wait_for(lambda: standby.promoted, timeout=10.0), \
+                f"flip {flip}: standby never promoted"
+            leader, standby = standby, None
+            assert time.time() - t_kill < 5.0
+            # terms are strictly monotonic across failovers
+            assert leader.epoch > old_epoch
+
+            # nothing lost: every pre-crash job is still known, with a
+            # legal status progression (Running may have Completed)
+            def queue_matches():
+                try:
+                    rows = {j.job_id: j.status for j in
+                            cli.query_jobs(include_history=True).jobs}
+                except grpc.RpcError:
+                    return False
+                if not set(pre) <= set(rows):
+                    return False
+                legal = {"Pending": ("Pending", "Running", "Completed"),
+                         "Running": ("Running", "Completed"),
+                         "Completed": ("Completed",)}
+                return all(rows[j] in legal.get(st, (st,))
+                           for j, st in pre.items())
+
+            assert wait_for(queue_matches, timeout=10.0), \
+                f"flip {flip}: queue diverged from pre-crash state"
+            assert all(status(j) == "Running" for j in long_ids)
+
+            # craneds learn the new term (re-register or push), then
+            # the deposed leader's in-flight dispatch is fenced
+            assert wait_for(
+                lambda: all(d._fencing_epoch >= leader.epoch
+                            for d in craneds),
+                timeout=10.0), f"flip {flip}: craneds never re-latched"
+            rep = craneds[0].ExecuteStep(
+                pb.ExecuteStepRequest(job_id=10_000 + flip,
+                                      fencing_epoch=old_epoch), None)
+            assert not rep.ok and "fenced" in rep.error
+
+            # the promoted leader schedules NEW work promptly
+            probe = submit(f"echo flip-{flip}", f"probe{flip}_%j.out")
+            assert wait_for(lambda: status(probe) == "Completed",
+                            timeout=10.0), \
+                f"flip {flip}: new leader never scheduled fresh work"
+
+            # resurrect the dead ctld as the new hot standby (same
+            # port, same WAL dir) for the next flip
+            standby = _start_standby(dead_name, tmp_path, dead_port,
+                                     peer_port=leader.port)
+            ctlds.append(standby)
+
+        # across all three flips nothing ever ran twice
+        assert dispatches and all(v == 1 for v in dispatches.values())
+        for j in long_ids:
+            hits = sum(v for (_, jid, _), v in dispatches.items()
+                       if jid == j)
+            assert hits == 1, f"job {j} dispatched {hits} times"
+
+        # the kill path still works through the final leader
+        for j in long_ids:
+            cli.cancel(j)
+        assert wait_for(lambda: all(
+            leader.sched.job_info(j) is not None
+            and leader.sched.job_info(j).status == JobStatus.CANCELLED
+            for j in long_ids), timeout=10.0)
+        assert leader.server.failovers >= 1
+    finally:
+        cli.close()
+        for d in craneds:
+            d.stop()
+        for c in ctlds:
+            try:
+                c.kill()
+            except Exception:
+                pass

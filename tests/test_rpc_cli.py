@@ -276,3 +276,54 @@ def test_stream_truncated_flag_and_cursor(ctld):
             break
         cursor = page[-1]
     assert seen == sorted(ids)
+
+
+def test_requeue_rpc_and_cli(ctld, capsys):
+    """Operator requeue over the wire + crequeue (reference RequeueJob,
+    Crane.proto:1407)."""
+    client, server, sched, port = ctld
+    jid = client.submit(job_spec(runtime=100.0)).job_id
+    client.tick(0.0)
+    assert client.query_jobs().jobs[0].status == "Running"
+
+    assert client.requeue(jid).ok
+    job = client.query_jobs().jobs[0]
+    assert job.status == "Pending" and job.requeue_count == 1
+
+    # nothing to requeue while pending
+    rep = client.requeue(jid)
+    assert not rep.ok and "pending" in rep.error
+    # the re-placed incarnation requeues from the CLI too
+    client.tick(1.0)
+    rc, _ = run_cli(capsys, port, "crequeue", str(jid))
+    assert rc == 0
+    assert client.query_jobs().jobs[0].status == "Pending"
+    # unknown job -> nonzero exit with the refusal on stderr
+    rc, out = run_cli(capsys, port, "crequeue", "999")
+    assert rc == 1 and "999" in out.err
+
+
+def test_job_summary_rpc_and_cli(ctld, capsys):
+    """Per-state counts (reference QueryJobSummary, Crane.proto:1588)
+    + csummary."""
+    client, server, sched, port = ctld
+    client.submit(job_spec(runtime=5.0, user="alice"))
+    running = client.submit(job_spec(runtime=100.0, user="bob")).job_id
+    held = client.submit(job_spec(runtime=100.0, user="bob")).job_id
+    client.hold(held)
+    client.tick(0.0)
+    client.tick(6.0)
+
+    rep = client.query_job_summary()
+    counts = {s.status: s.count for s in rep.states}
+    assert rep.total == 3
+    assert counts == {"COMPLETED": 1, "RUNNING": 1, "PENDING": 1}
+    # filters compose
+    assert client.query_job_summary(user="bob").total == 2
+    assert client.query_job_summary(user="nobody").total == 0
+
+    rc, out = run_cli(capsys, port, "csummary")
+    assert rc == 0
+    assert "RUNNING" in out.out and "# total 3" in out.out
+    rc, out = run_cli(capsys, port, "csummary", "-u", "bob")
+    assert rc == 0 and "# total 2" in out.out

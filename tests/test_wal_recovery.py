@@ -183,3 +183,143 @@ def test_compact_drops_finalized(tmp_path):
     replayed = WriteAheadLog.replay(path)
     assert set(replayed) == {j2, j2 + 1}
     wal.close()
+
+
+# ---- HA additions: seq cursor, rotation, tail buffer, crash-safe
+# compaction (the replication feed's invariants) ----
+
+
+def test_seq_rotation_tail_and_segment_replay(tmp_path):
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    j1 = sched.submit(spec(runtime=500.0), now=0.0)
+    j2 = sched.submit(spec(runtime=500.0), now=0.0)
+    assert wal.seq == 2
+    assert wal.rotate() == 2            # seals .seg.0000000000000002
+    j3 = sched.submit(spec(), now=1.0)
+    assert wal.seq == 3
+    # the in-memory tail spans the rotation; cursor fetch works
+    assert [s for s, _ in wal.tail_since(0)] == [1, 2, 3]
+    assert [s for s, _ in wal.tail_since(2)] == [3]
+    assert wal.tail_since(3) == []      # caught up
+    assert wal.tail_since(99) is None   # diverged follower: resync
+    # replay = sealed segments + active file; after_seq skips the prefix
+    assert set(WriteAheadLog.replay(path)) == {j1, j2, j3}
+    assert set(WriteAheadLog.replay(path, after_seq=2)) == {j3}
+    wal.close()
+    # a reopened WAL resumes the counter past the sealed segment...
+    wal2 = WriteAheadLog(path)
+    assert wal2.seq == 3
+    # ...with an empty tail buffer: any cursor forces a resync
+    assert wal2.tail_since(0) is None
+    assert wal2.tail_since(3) == []
+    wal2.close()
+
+
+def test_prune_segments_covered_by_snapshot(tmp_path):
+    from cranesched_tpu.ctld.wal import _segment_files
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    sched.submit(spec(runtime=500.0), now=0.0)
+    first = wal.rotate()
+    sched.submit(spec(runtime=500.0), now=1.0)
+    second = wal.rotate()
+    assert len(_segment_files(path)) == 2
+    # a snapshot through `first` only covers the first segment
+    assert wal.prune_segments(first) == 1
+    assert len(_segment_files(path)) == 1
+    assert wal.prune_segments(second) == 1
+    assert _segment_files(path) == []
+    wal.close()
+
+
+def test_compact_preserves_seq_and_absorbs_segments(tmp_path):
+    from cranesched_tpu.ctld.wal import _segment_files
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    j1 = sched.submit(spec(runtime=1.0), now=0.0)
+    j2 = sched.submit(spec(cpu=8.0, runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    wal.rotate()
+    cluster.advance_to(2.0)
+    sched.process_status_changes()
+    assert sched.job_info(j1).status == JobStatus.COMPLETED
+    # the survivor's last pre-compact record seq must be preserved (a
+    # restarted leader must not reuse seqs a follower already consumed)
+    pre = {r["job"]["job_id"]: r.get("seq", 0)
+           for r in WriteAheadLog._iter_records(path)}
+    seq_before = wal.seq
+    wal.compact()
+    assert _segment_files(path) == []    # segments absorbed
+    # segments were present, so j1 survives as a terminal TOMBSTONE
+    # (dropping it mid-absorption could resurrect it on a crash)
+    lines = [json.loads(line) for line in open(path)]
+    assert {r["job"]["job_id"] for r in lines} == {j1, j2}
+    assert {r["job"]["job_id"]: r["seq"] for r in lines} == pre
+    assert wal.seq == seq_before
+    # the next (segment-free) compact drops the tombstone
+    wal.compact()
+    lines = [json.loads(line) for line in open(path)]
+    assert {r["job"]["job_id"] for r in lines} == {j2}
+    assert lines[-1]["seq"] == pre[j2]   # original seq preserved
+    assert wal.seq == seq_before
+    wal.close()
+
+
+def test_kill_during_compact_leaves_log_replayable(tmp_path,
+                                                   monkeypatch):
+    import os as _os
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    j1 = sched.submit(spec(runtime=1.0), now=0.0)
+    j2 = sched.submit(spec(cpu=8.0, runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    wal.rotate()
+    cluster.advance_to(2.0)
+    sched.process_status_changes()
+    before = {jid: (ev, job.status)
+              for jid, (ev, job) in WriteAheadLog.replay(path).items()}
+
+    # crash point 1: before the rename lands — old log must be intact
+    def boom(src, dst):
+        raise OSError("kill -9 mid-compact")
+    monkeypatch.setattr("cranesched_tpu.ctld.wal.os.replace", boom)
+    try:
+        wal.compact()
+    except OSError:
+        pass
+    monkeypatch.undo()
+    after = {jid: (ev, job.status)
+             for jid, (ev, job) in WriteAheadLog.replay(path).items()}
+    assert after == before               # nothing lost, nothing changed
+
+    # crash point 2: rename landed, segment unlink didn't — stale
+    # non-terminal records of the finished job still sit in the
+    # segment, and replay must NOT resurrect it (the compacted active
+    # file keeps its terminal tombstone precisely for this window)
+    wal2 = WriteAheadLog(path)
+    monkeypatch.setattr("cranesched_tpu.ctld.wal.os.unlink",
+                        lambda p: (_ for _ in ()).throw(
+                            OSError("kill -9 mid-unlink")))
+    try:
+        wal2.compact()
+    except OSError:
+        pass
+    monkeypatch.undo()
+    after2 = WriteAheadLog.replay(path)
+    assert after2[j1][1].status == JobStatus.COMPLETED   # not resurrected
+    assert after2[j2][1].status == JobStatus.RUNNING
+
+    # restart after the crash: compaction converges (absorb the
+    # leftover segment, then drop the tombstone)
+    wal3 = WriteAheadLog(path)
+    wal3.compact()
+    wal3.compact()
+    final = WriteAheadLog.replay(path)
+    assert set(final) == {j2}
+    assert final[j2][1].status == JobStatus.RUNNING
+    wal3.close()
