@@ -6,16 +6,21 @@
 # tier1-obs  — fast lane: only the observability tests (@pytest.mark.obs
 #              in tests/test_obs.py) — seconds, not minutes.  Use while
 #              iterating on obs/, the cycle trace, or the watchdog.
-# tier1-perf — prelude smoke lane (tools/tier1_perf.sh): bench.py at a
+# tier1-perf — perf smoke lane (tools/tier1_perf.sh): bench.py at a
 #              tiny CPU shape, asserting the scheduler cycle's prelude
-#              share stays <= 25% of wall time (guards the factored
-#              mask table / stable-jit-shape prelude work).
+#              share stays <= 25% and the LOCK-HELD share (prelude +
+#              commit) <= 35% of wall time, and that group commit keeps
+#              fsyncs-per-cycle == WAL groups (<= 3).
 # tier1-ha   — HA failover lane (@pytest.mark.ha in
 #              tests/test_ha_failover.py): leader+standby e2e — kill
 #              the leader, assert promotion, fencing, and no lost or
 #              double-dispatched jobs.
+# tier1-commit — commit-path lane: WAL recovery/group-commit + commit
+#              and dispatch-ring tests only — seconds, not minutes.
+#              Use while iterating on wal.py, _commit, or the
+#              dispatcher fan-out.
 
-.PHONY: tier1 tier1-obs tier1-perf tier1-ha
+.PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit
 
 tier1:
 	bash tools/tier1.sh
@@ -29,4 +34,10 @@ tier1-perf:
 
 tier1-ha:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m ha \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-commit:
+	env JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_wal_recovery.py tests/test_commit_dispatch.py \
+	  -q -m "not slow" \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -94,12 +94,10 @@ def _devices_with_timeout(timeout_s: float) -> dict:
     }
 
 
-def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
-    """One REAL scheduler cycle at a reduced shape: builds a cluster
-    spread over four partitions, submits a queue, runs two cycles (the
-    first pays jit compiles) and reports the second cycle's phase split
-    straight from the cycle trace — the prelude/solve/commit numbers
-    the device-resident mask table is accountable for."""
+def _build_sched(num_jobs: int, num_nodes: int, wal_dir=None):
+    """Cluster + scheduler at a reduced shape, shared by the cycle and
+    commit benches; with ``wal_dir`` a REAL fsyncing WAL is attached so
+    the traces carry honest durability-barrier counts."""
     from cranesched_tpu.ctld import (
         JobScheduler,
         JobSpec,
@@ -107,6 +105,7 @@ def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
         ResourceSpec,
         SchedulerConfig,
     )
+    from cranesched_tpu.ctld.wal import WriteAheadLog
 
     rng = np.random.default_rng(1)
     meta = MetaContainer()
@@ -118,8 +117,13 @@ def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
                                is_capacity=True),
             partitions=(f"p{i % 4}",))
         meta.craned_up(i)
+    wal = None
+    if wal_dir is not None:
+        wal = WriteAheadLog(os.path.join(wal_dir, "bench.wal"),
+                            fsync=True)
     sched = JobScheduler(meta, SchedulerConfig(
-        schedule_batch_size=num_jobs, backfill_max_jobs=num_jobs))
+        schedule_batch_size=num_jobs, backfill_max_jobs=num_jobs),
+        wal=wal)
 
     def submit(k, now):
         for _ in range(k):
@@ -130,24 +134,86 @@ def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
                 time_limit=int(rng.integers(60, 86400)),
                 partition=f"p{rng.integers(0, 4)}"), now=now)
 
-    # three cycles: the first pays the solver compiles, the second the
-    # recompiles from the running-set bucket jumping off zero; topping
-    # the queue back up between cycles holds every jit shape constant,
-    # so the third cycle is the steady state the trace should describe
-    submit(num_jobs, 0.0)
-    for c in range(3):
-        sched.schedule_cycle(now=float(c + 1))
-        submit(num_jobs - len(sched.pending), float(c + 1) + 0.5)
-    trace = sched.cycle_trace.snapshot()[-1]
+    return sched, submit
+
+
+def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
+    """One REAL scheduler cycle at a reduced shape: builds a cluster
+    spread over four partitions, submits a queue, runs two cycles (the
+    first pays jit compiles) and reports the second cycle's phase split
+    straight from the cycle trace — the prelude/solve/commit numbers
+    the device-resident mask table is accountable for.  A real
+    fsyncing WAL (temp dir) is attached so ``wal_fsyncs_per_cycle``
+    measures actual durability barriers under group commit."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        sched, submit = _build_sched(num_jobs, num_nodes,
+                                     wal_dir=wal_dir)
+        # three cycles: the first pays the solver compiles, the second
+        # the recompiles from the running-set bucket jumping off zero;
+        # topping the queue back up between cycles holds every jit
+        # shape constant, so the third cycle is the steady state the
+        # trace should describe
+        submit(num_jobs, 0.0)
+        for c in range(3):
+            sched.schedule_cycle(now=float(c + 1))
+            submit(num_jobs - len(sched.pending), float(c + 1) + 0.5)
+        trace = sched.cycle_trace.snapshot()[-1]
+        sched.wal.close()
     out = {k: trace[k] for k in ("solver", "prelude_ms", "solve_ms",
-                                 "commit_ms", "total_ms", "num_streams")
+                                 "commit_ms", "dispatch_ms", "total_ms",
+                                 "num_streams", "wal_groups")
            if k in trace}
     out["jobs"] = num_jobs
     out["nodes"] = num_nodes
+    out["wal_fsyncs_per_cycle"] = int(trace.get("wal_fsyncs", 0))
     total = max(float(trace.get("total_ms", 0.0)), 1e-9)
     out["prelude_share"] = round(
         float(trace.get("prelude_ms", 0.0)) / total, 4)
+    out["lock_held_share"] = round(
+        (float(trace.get("prelude_ms", 0.0))
+         + float(trace.get("commit_ms", 0.0))) / total, 4)
     return out
+
+
+def _measure_commit(num_jobs: int = 10_000,
+                    num_nodes: int = 1_024) -> dict:
+    """Commit-path microbench: place ``num_jobs`` single-node jobs in
+    one cycle against a real fsyncing WAL (temp dir — tmpfs on CI) and
+    report the lock-held commit time plus the fsync count.  Group
+    commit's acceptance bar: fsyncs per cycle == WAL groups (<= 3),
+    not one per started job."""
+    import tempfile
+
+    # warm the jit caches on a throwaway scheduler with the SAME shapes
+    # so the measured instance's first cycle — an empty cluster taking
+    # the full placed wave — is commit-dominated, not compile-dominated
+    warm, warm_submit = _build_sched(num_jobs, num_nodes)
+    warm_submit(num_jobs, 0.0)
+    warm.schedule_cycle(now=1.0)
+    with tempfile.TemporaryDirectory() as wal_dir:
+        sched, submit = _build_sched(num_jobs, num_nodes,
+                                     wal_dir=wal_dir)
+        wal = sched.wal
+        submit(num_jobs, 0.0)
+        f0, g0 = wal.fsync_total, wal.groups_total
+        sched.schedule_cycle(now=1.0)
+        trace = sched.cycle_trace.snapshot()[-1]
+        fsyncs = wal.fsync_total - f0
+        groups = wal.groups_total - g0
+        wal.close()
+    return {
+        "jobs": num_jobs, "nodes": num_nodes,
+        "placed": int(trace.get("placed", 0)),
+        "commit_ms": trace.get("commit_ms"),
+        "dispatch_ms": trace.get("dispatch_ms"),
+        "total_ms": trace.get("total_ms"),
+        "wal_fsyncs": int(fsyncs),
+        "wal_groups": int(groups),
+        "fsyncs_equal_groups": bool(fsyncs == groups),
+        "groups_le_3": bool(groups <= 3),
+    }
 
 
 def main() -> int:
@@ -394,6 +460,17 @@ def main() -> int:
         except Exception as exc:  # never sink the headline number
             sched_cycle = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # commit-path microbench: group-commit fsync amortization +
+    # lock-held commit time on a place-everything cycle
+    commit_bench = None
+    cj = int(os.environ.get("BENCH_COMMIT_JOBS", 10_000))
+    cn = int(os.environ.get("BENCH_COMMIT_NODES", 1_024))
+    if cj > 0 and cn > 0:
+        try:
+            commit_bench = _measure_commit(cj, cn)
+        except Exception as exc:
+            commit_bench = {"error": f"{type(exc).__name__}: {exc}"}
+
     print(json.dumps({
         "metric": "decisions_per_sec",
         "value": round(decisions_per_sec, 1),
@@ -408,6 +485,7 @@ def main() -> int:
             "placed": placements_placed,
             "num_streams": bench_streams,
             "sched_cycle": sched_cycle,
+            "commit": commit_bench,
             "device": str(dev), "repeats": repeats,
             "device_acquisition": acquisition,
         },

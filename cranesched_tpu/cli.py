@@ -660,13 +660,14 @@ def cmd_cstats(args) -> int:
                  t.get("candidates"), t.get("placed"),
                  t.get("backfilled"), t.get("preempted"),
                  t.get("prelude_ms"), t.get("solve_ms"),
-                 t.get("commit_ms"), t.get("lock_held_ms"),
-                 t.get("total_ms"))
+                 t.get("commit_ms"), t.get("dispatch_ms"),
+                 t.get("lock_held_ms"), t.get("total_ms"),
+                 t.get("wal_fsyncs"))
                 for t in doc.get("cycle_trace", [])]
         print(_fmt_table(rows, (
             "NOW", "SOLVER", "QUEUE", "CAND", "PLACED", "BACKFILL",
             "PREEMPT", "PRELUDE_MS", "SOLVE_MS", "COMMIT_MS",
-            "LOCK_MS", "TOTAL_MS")))
+            "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC")))
         return 0
     if getattr(args, "metrics", False):
         rows = []

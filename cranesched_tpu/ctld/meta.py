@@ -278,6 +278,30 @@ class MetaContainer:
             n.running_jobs.add(job_id)
         return True
 
+    def malloc_resource_batch(self, entries) -> list[bool]:
+        """Commit a whole placed set in one call: ``entries`` is a list
+        of (job_id, node_ids, req) handled sequentially in order, so an
+        entry sees every earlier entry's subtraction exactly as
+        per-entry ``malloc_resource`` calls would.  Returns the
+        per-entry all-or-none outcomes.  This is the commit hot path at
+        10^4–10^5 placements per cycle — one call, hoisted lookups,
+        instead of a method call per job."""
+        nodes = self.nodes
+        per_node = self._per_node
+        out: list[bool] = []
+        for job_id, node_ids, req in entries:
+            ns = [nodes[i] for i in node_ids]
+            reqs = per_node(req, len(ns))
+            if not all(n.schedulable and (r <= n.avail).all()
+                       for n, r in zip(ns, reqs)):
+                out.append(False)
+                continue
+            for n, r in zip(ns, reqs):
+                n.avail = n.avail - r
+                n.running_jobs.add(job_id)
+            out.append(True)
+        return out
+
     def free_resource(self, job_id: int, node_ids: Iterable[int],
                       req) -> None:
         node_ids = list(node_ids)

@@ -92,6 +92,38 @@ class RunLedger:
             self.suspended[i] = False
         self._rows_of[job_id] = rows
 
+    def add_batch(self, entries) -> None:
+        """Register a whole just-started set in one call: ``entries``
+        is a list of ``add`` argument tuples.  Capacity is ensured once
+        for the batch (no mid-loop doubling churn) and the row fill
+        runs with hoisted array refs — the commit-phase batching
+        counterpart of meta.malloc_resource_batch."""
+        need = sum(len(e[1]) for e in entries)
+        while len(self._free) < need:
+            self._grow()
+        node, alloc = self.node, self.alloc
+        cpus, cpu_total = self.cpus, self.cpu_total
+        end, active, susp = self.end_time, self.active, self.suspended
+        free_pop = self._free.pop
+        for job_id, node_ids, allocs, end_time, node_cpu_totals in \
+                entries:
+            if job_id in self._rows_of:
+                self.remove(job_id)
+            rows = []
+            for node_id, a, ct in zip(node_ids, allocs,
+                                      node_cpu_totals):
+                i = free_pop()
+                rows.append(i)
+                node[i] = node_id
+                alloc[i] = a
+                cpus[i] = np.float32(float(a[DIM_CPU]) / CPU_SCALE)
+                cpu_total[i] = np.float32(
+                    max(float(ct) / CPU_SCALE, 1e-9))
+                end[i] = end_time
+                active[i] = True
+                susp[i] = False
+            self._rows_of[job_id] = rows
+
     def remove(self, job_id: int) -> None:
         for i in self._rows_of.pop(job_id, ()):
             self.active[i] = False

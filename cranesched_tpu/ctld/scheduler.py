@@ -77,7 +77,11 @@ _MET_CYCLES = _OBS.counter(
     "crane_cycles_total", "scheduling cycles completed")
 _MET_PHASE = _OBS.histogram(
     "crane_cycle_phase_seconds",
-    "wall time per cycle phase (label phase=prelude|solve|commit)")
+    "wall time per cycle phase "
+    "(label phase=prelude|solve|commit|dispatch)")
+_MET_COMMIT_BATCH = _OBS.histogram(
+    "crane_commit_batch_jobs", "jobs committed per _commit batch",
+    buckets=tuple(float(2 ** k) for k in range(18)))
 _MET_LOCK = _OBS.histogram(
     "crane_lock_held_seconds",
     "server-lock-held time per cycle (prelude + commit, never solve)")
@@ -154,6 +158,11 @@ class SchedulerConfig:
     # (parallel/sharded.py).  Backfill and packed cycles always run on
     # device.  All five are bit-identical on placements.
     solver: str = "auto"
+    # post-commit dispatch fan-out width (YAML ``DispatchWorkers``).
+    # None sizes the dispatcher pool from the cluster:
+    # max(8, nodes // 64), capped at 128 — a 10k-node cluster gets 128
+    # concurrent pushes instead of the historical hardcoded 8.
+    dispatch_workers: int | None = None
 
     def __post_init__(self):
         if self.preempt_mode not in ("off", "requeue", "cancel"):
@@ -299,6 +308,15 @@ class JobScheduler:
         self.meta = meta
         self.config = config or SchedulerConfig()
         self.dispatch = dispatch or (lambda job, nodes: None)
+        # optional batched dispatch seam (GrpcDispatcher.wire sets it):
+        # one call for the whole post-commit ring with per-craned
+        # coalescing; None falls back to per-job self.dispatch
+        self.dispatch_batch = None
+        # ordered post-commit dispatch ring: (job, node_ids) queued
+        # under the lock by _commit/_commit_preemption, drained with
+        # the lock RELEASED by the cycle's final phase — and only after
+        # the WAL group's fsync returned (durable-before-dispatch)
+        self._dispatch_ring: collections.deque = collections.deque()
         self.wal = wal
         # HA fencing: this ctld's leadership term, stamped into every
         # craned push/registration by the dispatcher + server so craneds
@@ -908,7 +926,19 @@ class JobScheduler:
             (job_id, step_id, status, exit_code, now, incarnation))
 
     def process_status_changes(self) -> int:
-        """Drain the queue (cycle step 1).  Returns #processed."""
+        """Drain the queue (cycle step 1).  Returns #processed.
+
+        All WAL events from one drain (requeues, finalize tombstones)
+        commit as one group — inside a cycle this nests into the
+        cycle's group; called standalone (Tick RPC, tests) it opens its
+        own, so a big drain still pays one fsync, not one per job."""
+        self._wal_begin()
+        try:
+            return self._process_status_changes()
+        finally:
+            self._wal_flush()
+
+    def _process_status_changes(self) -> int:
         while self._step_report_queue:
             args = self._step_report_queue.popleft()
             job_id, step_id, status, exit_code, now, incarnation = args
@@ -994,6 +1024,19 @@ class JobScheduler:
             [self.meta.nodes[n].total[DIM_CPU] for n in job.node_ids])
         if job.status == JobStatus.SUSPENDED:
             self._ledger.suspend(job.job_id, now)
+
+    def _ledger_add_batch(self, jobs: list[Job], now: float) -> None:
+        """Batch form of _ledger_add for the commit hot path: the whole
+        just-started set registers its rows in one ledger call (started
+        jobs are RUNNING, so no suspend bookkeeping here)."""
+        if not jobs:
+            return
+        nodes = self.meta.nodes
+        self._ledger.add_batch(
+            [(job.job_id, job.node_ids, self._job_alloc(job),
+              self._effective_end(job, now),
+              [nodes[n].total[DIM_CPU] for n in job.node_ids])
+             for job in jobs])
 
     def _malloc_run_limits(self, job: Job) -> bool:
         """Schedule-time QoS limit check + usage take (reference
@@ -1563,7 +1606,105 @@ class JobScheduler:
         ResReduceEvents, the reference's NodeSelect revalidation
         pattern, JobScheduler.cpp:1437-1540) flags touched nodes, and
         _commit re-checks pending membership, licenses, QoS and the
-        authoritative ledger per job."""
+        authoritative ledger per job.
+
+        WAL group commit: every lock-held segment of the cycle runs
+        inside one WAL group (one write + one fsync for all its
+        events), flushed BEFORE each yield — a group must never stay
+        open across a lock release or RPC-path appends (submit acks)
+        would buffer without their durability barrier.  The last
+        yielded closure drains the post-commit dispatch ring, so no
+        dispatch is issued until the group holding its job's ``start``
+        record is durable."""
+        wal = self.wal
+        self._wal_cycle_base = ((wal.fsync_total, wal.groups_total)
+                                if wal is not None else (0, 0))
+        self._wal_begin()
+        try:
+            started = yield from self._cycle_body(now)
+            return started
+        finally:
+            # safety net for the watchdog's gen.close() and crashed
+            # phases: no WAL event may sit buffered across cycles, and
+            # a job committed to RUNNING must still get its dispatch
+            # (drained inline here; the normal path drained lock-free)
+            self._wal_flush()
+            self._drain_dispatch_ring()
+
+    def _wal_begin(self) -> None:
+        if self.wal is not None:
+            self.wal.begin_batch()
+
+    def _wal_flush(self) -> None:
+        if self.wal is not None:
+            self.wal.commit_batch()
+
+    def _queue_dispatch(self, job: Job, node_ids: list[int]) -> None:
+        """Ring entries capture incarnation + fencing epoch NOW, under
+        the ctld lock at commit time: the ring drains lock-RELEASED, so
+        a requeue or lease loss between queue and drain must not let a
+        push go out stamped with the job's newer identity (the
+        dispatcher's staleness guard and craned-side fencing both key
+        off the values as of the commit).  The current WAL seq rides
+        along as the durability watermark — the job's start record has
+        seq <= it, so the drain can enforce durable-before-dispatch
+        even on a failed barrier."""
+        self._dispatch_ring.append((job, list(node_ids),
+                                    job.requeue_count,
+                                    self.fencing_epoch,
+                                    self.wal.seq
+                                    if self.wal is not None else 0))
+
+    def _drain_dispatch_ring(self) -> int:
+        """Issue every queued dispatch in commit order.  With a batched
+        seam wired (GrpcDispatcher.dispatch_batch) the whole ring goes
+        out in one call so the dispatcher can coalesce per craned.
+
+        Entries whose WAL watermark is not yet durable are DROPPED, not
+        dispatched: that only happens when the group's fsync failed
+        (the daemon is about to die) — pushing work whose start record
+        never hit disk would resurrect as a ghost allocation after the
+        recovery replay requeues the job."""
+        ring = self._dispatch_ring
+        if not ring:
+            return 0
+        items: list[tuple] = []
+        while ring:
+            items.append(ring.popleft())
+        if self.wal is not None:
+            durable = self.wal.durable_seq
+            items = [it for it in items if it[4] <= durable]
+            if not items:
+                return 0
+        if self.dispatch_batch is not None:
+            self.dispatch_batch(items)
+        else:
+            for job, node_ids, *_ in items:
+                self.dispatch(job, node_ids)
+        return len(items)
+
+    def _dispatch_phase(self):
+        """The cycle's final yielded closure: drain the dispatch ring
+        with the lock RELEASED.  Only built after _wal_flush — the
+        durable-before-dispatch boundary."""
+        import time as _time
+
+        def run():
+            t0 = _time.perf_counter()
+            n = self._drain_dispatch_ring()
+            return n, (_time.perf_counter() - t0) * 1e3
+
+        return run
+
+    def _note_dispatch(self, result) -> None:
+        n, ms = result
+        self._cur_trace["dispatch_ms"] = round(ms, 3)
+        lc = self.stats.get("last_cycle")
+        if isinstance(lc, dict):
+            lc["dispatch_ms"] = round(ms, 3)
+        _MET_PHASE.observe(ms / 1e3, phase="dispatch")
+
+    def _cycle_body(self, now: float):
         import time as _time
         t0 = _time.perf_counter()
         self._cur_trace = {
@@ -1627,14 +1768,19 @@ class JobScheduler:
         if packed:
             state = make_cluster_state(avail, total, alive, cost0)
             pbatch = self._packed_batch(jobs_batch.dense, ordered)
+            self._wal_flush()
             placements = yield self._traced_solve(
                 "packed", lambda: solve_packed(
                     state, pbatch, max_nodes=max_nodes)[0])
+            self._wal_begin()
             started = self._commit(ordered, placements, now,
                                    tasks=np.asarray(placements.tasks))
             started += self._try_preemption(ordered, now)
+            self._wal_flush()
             self._record_cycle_stats(t0, t_prelude, candidates, started,
                                      _time.perf_counter(), "packed")
+            if self._dispatch_ring:
+                self._note_dispatch((yield self._dispatch_phase()))
             return started
 
         if self.config.backfill:
@@ -1644,31 +1790,41 @@ class JobScheduler:
                     ordered, jobs_batch, avail, total, alive, cost0,
                     max_nodes, now)
                 started += self._try_preemption(ordered, now)
+                self._wal_flush()
                 self._record_cycle_stats(t0, t_prelude, candidates,
                                          started,
                                          _time.perf_counter(),
                                          "backfill-split")
+                if self._dispatch_ring:
+                    self._note_dispatch((yield self._dispatch_phase()))
                 return started
             state = self._timed_state(now, avail, total, alive, cost0)
             tbatch = self._timed_batch(jobs_batch.dense, ordered)
+            self._wal_flush()
             placements = yield self._traced_solve(
                 "backfill", lambda: solve_backfill(
                     state, tbatch, edges=self._grid.jnp_edges,
                     max_nodes=max_nodes)[0])
+            self._wal_begin()
             start_buckets = np.asarray(placements.start_bucket)
             self._cur_trace["backfilled"] = int(np.sum(
                 np.asarray(placements.placed) & (start_buckets > 0)))
         else:
+            self._wal_flush()
             placements, solver_name = yield self._traced_solve(
                 None, lambda: self._immediate_solve(
                     avail, total, alive, cost0, jobs_batch, max_nodes))
+            self._wal_begin()
             start_buckets = None
 
         started = self._commit(ordered, placements, now, start_buckets)
         started += self._try_preemption(ordered, now)
+        self._wal_flush()
         self._record_cycle_stats(
             t0, t_prelude, candidates, started, _time.perf_counter(),
             "backfill" if self.config.backfill else solver_name)
+        if self._dispatch_ring:
+            self._note_dispatch((yield self._dispatch_phase()))
         return started
 
     def _immediate_solve(self, avail, total, alive, cost0, jobs_batch,
@@ -1732,10 +1888,12 @@ class JobScheduler:
 
         state = self._timed_state(now, avail, total, alive, cost0)
         tb = self._timed_batch(head_batch, head)
+        self._wal_flush()
         placements, tstate = yield self._traced_solve(
             "backfill", lambda: solve_backfill(
                 state, tb, edges=self._grid.jnp_edges,
                 max_nodes=max_nodes))
+        self._wal_begin()
         head_start = np.asarray(placements.start_bucket)
         self._cur_trace["backfilled"] = int(np.sum(
             np.asarray(placements.placed) & (head_start > 0)))
@@ -1750,7 +1908,9 @@ class JobScheduler:
             return self._immediate_solve(
                 min_avail, total, alive, cost1, tail_batch, max_nodes)
 
+        self._wal_flush()
         placements2, _ = yield self._traced_solve(None, _tail_solve)
+        self._wal_begin()
         tail_placements = Placements(
             placed=placements2.placed[bf_max:],
             nodes=placements2.nodes[bf_max:],
@@ -1812,13 +1972,23 @@ class JobScheduler:
                       else (prelude_end - t0) * 1e3)
         solve_ms = float(self._cur_trace.get("solve_ms", 0.0))
         # commit = everything after the prelude that ran under the
-        # lock, i.e. total minus prelude minus the lock-released solves
+        # lock, i.e. total minus prelude minus the lock-released solves.
+        # Dispatch is NOT in here: the ring drains post-lock and its
+        # span lands separately in dispatch_ms (_note_dispatch).
         commit_ms = max(total_ms - prelude_ms - solve_ms, 0.0)
+        base_fsync, base_groups = getattr(self, "_wal_cycle_base",
+                                          (0, 0))
+        wal = self.wal
+        wal_fsyncs = (wal.fsync_total - base_fsync
+                      if wal is not None else 0)
+        wal_groups = (wal.groups_total - base_groups
+                      if wal is not None else 0)
         self.stats["last_cycle"] = {
             "solver": solver,
             "prelude_ms": round(prelude_ms, 3),
             "solve_commit_ms": round((t_end - t_prelude) * 1e3, 3),
             "total_ms": round(total_ms, 3),
+            "dispatch_ms": 0.0,
             "pending": len(candidates),
             "started": len(started),
             "running": len(self.running),
@@ -1831,8 +2001,14 @@ class JobScheduler:
             prelude_ms=round(prelude_ms, 3),
             solve_ms=round(solve_ms, 3),
             commit_ms=round(commit_ms, 3),
+            # placeholder: the dispatch ring drains AFTER this push (the
+            # cycle's last, lock-released phase) and _note_dispatch
+            # updates the ringed dict in place
+            dispatch_ms=0.0,
             total_ms=round(total_ms, 3),
             lock_held_ms=round(prelude_ms + commit_ms, 3),
+            wal_fsyncs=wal_fsyncs,
+            wal_groups=wal_groups,
             candidates=len(candidates),
             placed=len(started),
         )
@@ -2354,7 +2530,9 @@ class JobScheduler:
         if self.wal is not None:
             self.wal.job_started(job)
         self._trigger_dep_event(job)
-        self.dispatch(job, chosen)
+        # onto the ring: the push goes out post-lock, after the cycle's
+        # WAL group (holding this start record) is durable
+        self._queue_dispatch(job, chosen)
         return True
 
     def _evict(self, victim_id: int, now: float) -> None:
@@ -2658,14 +2836,38 @@ class JobScheduler:
         With the time axis, ``start_buckets`` marks future-start jobs:
         they hold in-cycle reservations and surface the "Priority" reason
         (the reference's flow at cpp:6795-6835) — only bucket-0 starts
-        dispatch."""
+        dispatch.
+
+        The commit scales with BATCHES, not jobs: admission checks that
+        are pure array functions (placed/reason rows, the mid-cycle
+        dirty-node flag) run as one vectorized pre-pass; the per-job
+        loop keeps only what must stay per-job (pending membership,
+        spec-epoch void, license/QoS takes with their undo ordering);
+        the ledger commit goes through meta.malloc_resource_batch +
+        _ledger_add_batch over the whole placed set; WAL ``start``
+        records land in the cycle's open group (one fsync for all);
+        dispatch is QUEUED on the ring and issued post-lock, after the
+        group's durability barrier."""
         events = self.meta.stop_logging()
         dirty_nodes = {ev.node_id for ev in events}
 
         placed = np.asarray(placements.placed)
         nodes_mat = np.asarray(placements.nodes)
         reasons = np.asarray(placements.reason)
+        valid_nodes = nodes_mat >= 0
+        # vectorized pre-pass: one gather flags every placement row
+        # touching a node some mid-cycle event dirtied, replacing a
+        # per-job set intersection
+        dirty_row = None
+        if dirty_nodes:
+            size = max(len(self.meta.nodes), max(dirty_nodes) + 1)
+            dirty_vec = np.zeros(size, dtype=bool)
+            dirty_vec[list(dirty_nodes)] = True
+            dirty_row = (dirty_vec[np.clip(nodes_mat, 0, size - 1)]
+                         & valid_nodes).any(axis=1)
         started: list[int] = []
+        admitted: list[Job] = []
+        future_start: list[tuple[Job, list[int]]] = []
         for i, job in enumerate(ordered):
             if (job.job_id not in self.pending or job.held
                     or job.spec is not getattr(job, "_plan_spec",
@@ -2684,17 +2886,15 @@ class JobScheduler:
                 # reference cpp:6797-6835: a future-start job reports
                 # "Resource" when its chosen nodes lack free resources
                 # right now, and "Priority" only when resources are free
-                # but running would delay a higher-priority reservation
-                node_ids = [int(n) for n in nodes_mat[i] if n >= 0]
-                req = job.spec.res.encode(self.meta.layout)
-                fits_now = all(
-                    (req <= self.meta.nodes[n].avail).all()
-                    for n in node_ids) if node_ids else False
-                job.pending_reason = (PendingReason.PRIORITY if fits_now
-                                      else PendingReason.RESOURCE)
+                # but running would delay a higher-priority reservation.
+                # The avail read must see this cycle's commits (the old
+                # per-job loop interleaved it with earlier jobs'
+                # mallocs), so it is DEFERRED until after the batch
+                # malloc below.
+                future_start.append(
+                    (job, nodes_mat[i][valid_nodes[i]].tolist()))
                 continue
-            node_ids = [int(n) for n in nodes_mat[i] if n >= 0]
-            if dirty_nodes.intersection(node_ids):
+            if dirty_row is not None and dirty_row[i]:
                 job.pending_reason = PendingReason.RESOURCE
                 continue
             if job.spec.licenses and not self.licenses.malloc(
@@ -2705,18 +2905,26 @@ class JobScheduler:
                 self.licenses.free(job.spec.licenses or {})
                 job.pending_reason = PendingReason.QOS_LIMIT
                 continue
-            job.node_ids = node_ids
+            job.node_ids = nodes_mat[i][valid_nodes[i]].tolist()
             job.task_layout = ([int(t) for t, n in
                                 zip(tasks[i], nodes_mat[i]) if n >= 0]
                                if tasks is not None else [])
-            if not self.meta.malloc_resource(job.job_id, node_ids,
-                                             self._job_alloc(job)):
+            admitted.append(job)
+        # batched ledger commit: ONE meta call checks and subtracts the
+        # whole placed set in admission order (each entry sees earlier
+        # subtractions exactly as per-job malloc_resource calls would)
+        oks = self.meta.malloc_resource_batch(
+            [(job.job_id, job.node_ids, self._job_alloc(job))
+             for job in admitted])
+        started_jobs: list[Job] = []
+        for job, ok in zip(admitted, oks):
+            if not ok:
                 self.licenses.free(job.spec.licenses or {})
                 self._free_run_limits(job)
                 job.node_ids = []
                 job.task_layout = []
-                job.alloc_cache = None  # never reuse a failed placement's
-                                        # per-node amounts
+                job.alloc_cache = None  # never reuse a failed
+                                        # placement's per-node amounts
                 job.pending_reason = PendingReason.RESOURCE
                 continue
             del self.pending[job.job_id]
@@ -2725,12 +2933,23 @@ class JobScheduler:
             job.pending_reason = PendingReason.NONE
             self._init_steps(job, now)
             self.running[job.job_id] = job
-            self._ledger_add(job, now)
-            if self.wal is not None:
-                self.wal.job_started(job)
-            self._trigger_dep_event(job)   # AFTER edges fire on start
-            self.dispatch(job, node_ids)
+            started_jobs.append(job)
             started.append(job.job_id)
+        for job, node_ids in future_start:
+            req = job.spec.res.encode(self.meta.layout)
+            fits_now = all(
+                (req <= self.meta.nodes[n].avail).all()
+                for n in node_ids) if node_ids else False
+            job.pending_reason = (PendingReason.PRIORITY if fits_now
+                                  else PendingReason.RESOURCE)
+        self._ledger_add_batch(started_jobs, now)
+        _MET_COMMIT_BATCH.observe(len(started_jobs))
+        wal = self.wal
+        for job in started_jobs:
+            if wal is not None:
+                wal.job_started(job)  # buffered into the cycle's group
+            self._trigger_dep_event(job)   # AFTER edges fire on start
+            self._queue_dispatch(job, job.node_ids)
         return started
 
     # ------------------------------------------------------------------

@@ -9,7 +9,12 @@ and archived to MongoDB.  On restart, JobScheduler::Init
 jobs are re-adopted.
 
 Here the WAL is an append-only JSON-lines file — human-debuggable, crash
-append-atomic (one line per event, fsync'd), and replayable in one pass.
+append-atomic (one line per event), and replayable in one pass.  Events
+are durable before they take effect: a lone append fsyncs immediately,
+while a ``group()``/``begin_batch()`` batch buffers its encoded lines
+and commits them with one write + one fsync (classic group commit — the
+durability barrier is amortized over the batch, and no dispatch happens
+for any job in the group until that barrier returns).
 Terminal jobs are retained as ``finalized`` tombstones; ``compact()``
 rewrites the live prefix the way the reference purges finalized rows.
 
@@ -24,11 +29,14 @@ full history.  Records written before the seq field replay as seq 0.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import glob
 import json
 import os
 from typing import IO
+
+from cranesched_tpu.obs import REGISTRY as _OBS
 
 from cranesched_tpu.ctld.defs import (
     ArraySpec,
@@ -210,6 +218,13 @@ def _job_from_dict(d: dict) -> Job:
     )
 
 
+_MET_WAL_FSYNC = _OBS.counter(
+    "crane_wal_fsync_total", "WAL durability barriers (os.fsync calls)")
+_MET_WAL_GROUP = _OBS.histogram(
+    "crane_wal_group_records", "records committed per WAL group",
+    buckets=tuple(float(2 ** k) for k in range(17)))
+
+
 def _fsync_dir(path: str) -> None:
     """fsync the directory holding ``path`` so a rename/unlink survives
     a host crash (an os.replace alone is only durable once the directory
@@ -252,8 +267,15 @@ class WriteAheadLog:
         self.seq = 0
         for f in _segment_files(path) + [path]:
             self.seq = max(self.seq, self._scan_max_seq(f))
+        # last seq known to be on disk; inside an open group, seq runs
+        # ahead of durable_seq until the group's single fsync returns
+        self.durable_seq = self.seq
         self._tail: collections.deque = collections.deque(
             maxlen=self.TAIL_BUFFER)
+        self._group_depth = 0
+        self._group_buf: list[tuple[int, str]] = []
+        self.fsync_total = 0    # actual os.fsync calls (fsync=True only)
+        self.groups_total = 0   # non-empty group flushes
         self._fh: IO[str] = open(path, "a", encoding="utf-8")
 
     @staticmethod
@@ -273,17 +295,70 @@ class WriteAheadLog:
         return last
 
     def close(self) -> None:
+        self._flush_group()
         self._fh.close()
 
     def _append(self, event: str, job: Job) -> None:
         self.seq += 1
         rec = {"seq": self.seq, "ev": event, "job": _job_to_dict(job)}
         line = json.dumps(rec, separators=(",", ":"))
+        if self._group_depth > 0:
+            # group commit: buffer the encoded line; seq numbers stay
+            # contiguous (we are under the server lock), the write and
+            # the single fsync happen at commit_batch
+            self._group_buf.append((self.seq, line))
+            return
         self._fh.write(line + "\n")
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
+            self.fsync_total += 1
+            _MET_WAL_FSYNC.inc()
+        self.durable_seq = self.seq
         self._tail.append((self.seq, line))
+
+    # -- group commit (one durability barrier per batch) --
+
+    def begin_batch(self) -> None:
+        """Open (or nest into) a commit group: subsequent appends buffer
+        in memory and become durable together at ``commit_batch``."""
+        self._group_depth += 1
+
+    def commit_batch(self) -> None:
+        """Close one nesting level; at depth zero, write every buffered
+        record with one ``write`` + one ``fsync``.  Tolerates being
+        called with no open group (flushes any residue) so safety-net
+        callers can invoke it unconditionally."""
+        if self._group_depth > 0:
+            self._group_depth -= 1
+        if self._group_depth == 0:
+            self._flush_group()
+
+    @contextlib.contextmanager
+    def group(self):
+        self.begin_batch()
+        try:
+            yield self
+        finally:
+            self.commit_batch()
+
+    def _flush_group(self) -> None:
+        if not self._group_buf:
+            return
+        buf = self._group_buf
+        self._group_buf = []
+        self._fh.write("".join(line + "\n" for _seq, line in buf))
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+            self.fsync_total += 1
+            _MET_WAL_FSYNC.inc()
+        # the tail buffer feeds HaFetchWal: records enter it only after
+        # the barrier, so a follower can never observe a non-durable seq
+        self.durable_seq = buf[-1][0]
+        self._tail.extend(buf)
+        self.groups_total += 1
+        _MET_WAL_GROUP.observe(len(buf))
 
     # -- replication feed (leader side) --
 
@@ -293,9 +368,9 @@ class WriteAheadLog:
         or None when the cursor fell off the buffer (or points past our
         history — a diverged follower): the caller must resync from a
         snapshot."""
-        if after_seq > self.seq:
+        if after_seq > self.durable_seq:
             return None
-        floor = self._tail[0][0] if self._tail else self.seq + 1
+        floor = self._tail[0][0] if self._tail else self.durable_seq + 1
         if after_seq + 1 < floor:
             return None
         out = [(s, line) for s, line in self._tail if s > after_seq]
@@ -307,6 +382,7 @@ class WriteAheadLog:
         """Seal the active file into a ``.seg.<lastseq>`` segment and
         start a fresh one.  Returns the sealed-through seq.  No-op on an
         empty active file."""
+        self._flush_group()
         self._fh.flush()
         if self._fh.tell() == 0:
             return self.seq
@@ -406,6 +482,10 @@ class WriteAheadLog:
         active-file rename and the segment unlink (replay reads segments
         first and nothing in the new active file would supersede them).
         The tombstones fall out on the next segment-free compact."""
+        # an open group's records would be silently dropped by the
+        # rewrite (they exist only in memory) — make them durable first;
+        # the group stays open for appends that follow the compact
+        self._flush_group()
         segments = _segment_files(self.path)
         keep: list[tuple[int, str]] = []
         if live is not None and not segments:

@@ -36,7 +36,8 @@ def capture_snapshot(scheduler, seq: int | None = None) -> dict:
     """Build the snapshot document.  Caller must hold the server lock —
     the document must be consistent with one WAL position."""
     if seq is None:
-        seq = scheduler.wal.seq if scheduler.wal is not None else 0
+        seq = (scheduler.wal.durable_seq
+               if scheduler.wal is not None else 0)
     jobs = []
     for col in (scheduler.pending, scheduler.running):
         for job in col.values():
@@ -176,7 +177,7 @@ class Snapshotter(threading.Thread):
         seq (0 = skipped, nothing new)."""
         from cranesched_tpu import ha as _ha
         with self.lock:
-            seq = self.wal.seq
+            seq = self.wal.durable_seq
             if seq - self.last_seq < self.min_records:
                 return 0
             doc = capture_snapshot(self.scheduler, seq)

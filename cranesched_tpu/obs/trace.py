@@ -11,8 +11,13 @@ Cycle-trace schema (ARCHITECTURE.md "Observability"):
     prelude_ms       float   lock-held bookkeeping before the solve
     solve_ms         float   lock-RELEASED time in yielded closures
     commit_ms        float   lock-held time after the first solve
+    dispatch_ms      float   lock-RELEASED post-commit push fan-out
     total_ms         float   wall time of the whole cycle
-    lock_held_ms     float   prelude_ms + commit_ms (never the solve)
+    lock_held_ms     float   prelude_ms + commit_ms (never the solve
+                             and never the dispatch drain)
+    wal_fsyncs       int     durability barriers this cycle (== WAL
+                             groups when group commit is active)
+    wal_groups       int     WAL groups flushed this cycle (<= 3)
     candidates       int     jobs considered this cycle
     placed           int     jobs started (incl. backfill tail)
     preempted        int     victims killed by this cycle

@@ -41,21 +41,65 @@ class _CranedStub(GrpcStub):
         return super().call(name, request, reply_cls)
 
 
+class _PushState:
+    """Per-job completion latch for the coalesced fan-out: a job's
+    pushes run on different per-node pool tasks, so the LAST node to
+    finish (success or not) fires the rollback if any node errored —
+    the coalesced analogue of the old per-job fan_out join."""
+
+    __slots__ = ("_lock", "_remaining", "_errors", "_rollback")
+
+    def __init__(self, remaining: int, rollback):
+        self._lock = threading.Lock()
+        self._remaining = remaining
+        self._errors: list[str] = []
+        self._rollback = rollback
+
+    def done(self, error: str) -> None:
+        with self._lock:
+            if error:
+                self._errors.append(error)
+            self._remaining -= 1
+            fire = self._remaining == 0 and bool(self._errors)
+        if fire:
+            self._rollback()
+
+
 class GrpcDispatcher:
-    def __init__(self, scheduler, max_workers: int = 8, tls=None):
+    def __init__(self, scheduler, max_workers: int | None = None,
+                 tls=None):
         self.scheduler = scheduler
         # utils.pki.TlsConfig: push channels to craneds dial TLS,
         # verified against the cluster CA (craneds serve their node
         # certs) — the internal fabric's encrypted half
         self.tls = tls
+        # fan-out width: explicit arg > SchedulerConfig.dispatch_workers
+        # (YAML DispatchWorkers) > derived from cluster size.  The old
+        # hardcoded 8 serialized a 10k-node cycle's pushes 8 at a time.
+        if max_workers is None:
+            max_workers = getattr(scheduler.config, "dispatch_workers",
+                                  None)
+        if max_workers is None:
+            max_workers = self.default_workers(
+                len(scheduler.meta.nodes))
+        self.max_workers = int(max_workers)
         self._stubs: dict[int, _CranedStub] = {}
         self._lock = threading.Lock()
-        self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
+        self._pool = futures.ThreadPoolExecutor(
+            max_workers=self.max_workers)
+
+    @staticmethod
+    def default_workers(num_nodes: int) -> int:
+        """max(8, nodes // 64), capped at 128: wide enough that a
+        10k-node commit wave drains in ~nodes/width push rounds, small
+        enough not to oversubscribe the ctld host."""
+        return min(max(8, num_nodes // 64), 128)
 
     def wire(self, scheduler) -> None:
         """Attach every dispatch seam in one place (wiring the seams
         individually has already produced a missed-seam bug once)."""
         scheduler.dispatch = self.dispatch
+        scheduler.dispatch_batch = self.dispatch_batch
         scheduler.dispatch_step = self.dispatch_step
         scheduler.dispatch_terminate = self.terminate
         scheduler.dispatch_terminate_step = self.terminate_step
@@ -88,16 +132,71 @@ class GrpcDispatcher:
     # ---- the dispatch seam ----
 
     def dispatch(self, job: Job, node_ids: list[int]) -> None:
-        """ExecuteStep/AllocJob fan-out, ASYNCHRONOUS: the caller holds
-        the ctld lock, so pushes must not block on craned RPCs (an
-        unreachable craned would stall pings from healthy nodes and
-        cascade false CranedDown events).  A failed push fails the job
-        via the normal status-change path (the reference frees resources
-        and marks Failed on dispatch errors, JobScheduler.cpp:1908-1967).
+        """ExecuteStep/AllocJob fan-out, ASYNCHRONOUS: pushes must not
+        block the caller on craned RPCs (an unreachable craned would
+        stall pings from healthy nodes and cascade false CranedDown
+        events).  A failed push fails the job via the normal
+        status-change path (the reference frees resources and marks
+        Failed on dispatch errors, JobScheduler.cpp:1908-1967).
 
         Batch jobs push ExecuteStep (implicit allocation + step 0 in
         one); alloc_only jobs push AllocJob (the allocation sits until
         steps arrive via dispatch_step)."""
+        self.dispatch_batch([(job, node_ids, job.requeue_count,
+                              self.scheduler.fencing_epoch)])
+
+    def dispatch_batch(self, items) -> None:
+        """Coalesced post-commit fan-out: the scheduler's dispatch ring
+        arrives as ONE call; requests are grouped per craned so N jobs
+        landing on one node become one pool task pushing back-to-back
+        on that node's channel, instead of N independent fan-outs
+        threading through the pool.  Per-job semantics are unchanged —
+        if any of a job's nodes fails, whatever DID land is rolled back
+        and the job fails via the status-change path.
+
+        ``items`` entries are ``(job, node_ids, incarnation,
+        fencing_epoch)`` (or 2-tuples, which re-read both from live
+        state).  The 4-tuple values are captured synchronously under
+        the ctld lock at commit time: the async pushes below can
+        outlive a requeue (node death while a push blocks on its RPC
+        timeout), and a stale failure report stamped with the job's
+        *current* requeue_count would defeat the staleness guard and
+        kill the healthy new incarnation; likewise a push built after
+        this ctld lost its lease must carry the OLD fencing epoch so
+        craneds that learned the new one reject it."""
+        by_node: dict[int, list[tuple]] = {}
+        for item in items:
+            job, node_ids = item[0], list(item[1])
+            if not node_ids:
+                continue
+            incarnation = (item[2] if len(item) > 2
+                           else job.requeue_count)
+            epoch = (item[3] if len(item) > 3
+                     else self.scheduler.fencing_epoch)
+            push, rollback, tasks = self._build_push(
+                job, node_ids, incarnation, epoch)
+            state = _PushState(len(node_ids), rollback)
+            for rank, node_id in enumerate(node_ids):
+                ntasks = tasks[rank] if rank < len(tasks) else 1
+                by_node.setdefault(node_id, []).append(
+                    (push, node_id, ntasks, state))
+        for entries in by_node.values():
+            self._pool.submit(self._push_node_batch, entries)
+
+    @staticmethod
+    def _push_node_batch(entries) -> None:
+        """One pool task per craned: push every job bound for this node
+        sequentially; a job's LAST completing node triggers its
+        rollback if any node errored."""
+        for push, node_id, ntasks, state in entries:
+            err = push(node_id, ntasks)
+            state.done(err)
+
+    def _build_push(self, job: Job, node_ids: list[int],
+                    incarnation: int, epoch: int):
+        """One job's push closure + rollback, built once per dispatch
+        (the pb encode + gang context are the per-job cost; per-node
+        work is just the request stamp + the RPC)."""
         verb = "AllocJob" if job.spec.alloc_only else "ExecuteStep"
         step0 = job.steps.get(0)
         step_pb = (step_spec_to_pb(step0.spec)
@@ -106,17 +205,6 @@ class GrpcDispatcher:
         tasks = job.task_layout or [1] * len(node_ids)
         gang = self._gang_ctx(job.job_id, node_ids,
                               int(sum(tasks[: len(node_ids)])))
-        # capture the incarnation NOW, synchronously under the ctld lock:
-        # the async fan_out below can outlive a requeue (node death while
-        # a push blocks on its RPC timeout), and a stale failure report
-        # stamped with the job's *current* requeue_count would defeat the
-        # staleness guard and kill the healthy new incarnation
-        incarnation = job.requeue_count
-        # same capture discipline for the fencing epoch: a push built
-        # after this ctld lost the lease must carry the OLD epoch so the
-        # craned (which learned the new one from the promoted standby)
-        # rejects it
-        epoch = self.scheduler.fencing_epoch
 
         def push(node_id, ntasks):
             stub = self._stub(node_id)
@@ -149,29 +237,25 @@ class GrpcDispatcher:
                 time.sleep(0.5)
             return reply.error
 
-        def fan_out():
-            errors = [e for e in map(push, node_ids,
-                                     tasks[: len(node_ids)]) if e]
-            if errors:
-                # roll back whatever DID land — guarded by OUR
-                # incarnation, so if the job was requeued and re-placed
-                # while a push blocked on its RPC timeout, this late
-                # cleanup cannot touch the healthy new incarnation.
-                # AllocJob pushes must be undone with FreeJob (an
-                # explicit allocation with zero steps ignores
-                # TerminateStep and would leak its cgroup + GRES).
-                undo = "FreeJob" if verb == "AllocJob" else \
-                    "TerminateStep"
-                for node_id in node_ids:
-                    self._try_call(node_id, undo,
-                                   pb.JobIdRequest(job_id=job.job_id,
-                                                   incarnation=incarnation,
-                                                   fencing_epoch=epoch))
-                self.scheduler.step_status_change(
-                    job.job_id, JobStatus.FAILED, 254, time.time(),
-                    incarnation=incarnation)
+        def rollback():
+            # roll back whatever DID land — guarded by OUR incarnation,
+            # so if the job was requeued and re-placed while a push
+            # blocked on its RPC timeout, this late cleanup cannot
+            # touch the healthy new incarnation.  AllocJob pushes must
+            # be undone with FreeJob (an explicit allocation with zero
+            # steps ignores TerminateStep and would leak its cgroup +
+            # GRES).
+            undo = "FreeJob" if verb == "AllocJob" else "TerminateStep"
+            for node_id in node_ids:
+                self._try_call(node_id, undo,
+                               pb.JobIdRequest(job_id=job.job_id,
+                                               incarnation=incarnation,
+                                               fencing_epoch=epoch))
+            self.scheduler.step_status_change(
+                job.job_id, JobStatus.FAILED, 254, time.time(),
+                incarnation=incarnation)
 
-        self._pool.submit(fan_out)
+        return push, rollback, tasks
 
     def _gang_ctx(self, job_id: int, node_ids: list[int],
                   ntasks: int, step_id: int = 0) -> dict:

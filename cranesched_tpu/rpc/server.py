@@ -566,7 +566,8 @@ class CtldServer:
                 "fencing_epoch": self.scheduler.fencing_epoch,
                 "wal_seq": (self.ha_follower.applied_seq
                             if self.ha_follower is not None
-                            else (wal.seq if wal is not None else 0)),
+                            else (wal.durable_seq
+                                  if wal is not None else 0)),
                 "replication_lag": lag,
                 "failovers_total": self.failovers,
                 "peer": self.ha_peer,
@@ -850,7 +851,7 @@ class CtldServer:
         self._require_authenticated(self._ident(context), context)
         with self._lock:
             wal = self.scheduler.wal
-            seq = wal.seq if wal is not None else 0
+            seq = wal.durable_seq if wal is not None else 0
             lag = 0
             leader = "" if self.ha_role == "leader" else self.ha_peer
             if self.ha_follower is not None:
@@ -886,7 +887,9 @@ class CtldServer:
                                        error="no WAL on this ctld")
             out = wal.tail_since(request.after_seq,
                                  limit=request.limit or 512)
-            seq = wal.seq
+            # the follower's replication cursor must never run ahead of
+            # the durability barrier — inside an open group `seq` does
+            seq = wal.durable_seq
             epoch = self.scheduler.fencing_epoch
         reply = pb.HaFetchReply(ok=True, wal_seq=seq,
                                 fencing_epoch=epoch)

@@ -182,7 +182,11 @@ class CraneConfig:
                 self.observability.get("CycleTraceRing", 64)),
             craned_timeout=float(sc.get("CranedTimeoutSec", 30)),
             preempt_mode=str(sc.get("PreemptMode", "off")).lower(),
-            solver=str(sc.get("Solver", "auto")).lower())
+            solver=str(sc.get("Solver", "auto")).lower(),
+            # post-commit push fan-out width; None lets the dispatcher
+            # derive it from cluster size (max(8, nodes // 64), cap 128)
+            dispatch_workers=(int(sc["DispatchWorkers"])
+                              if sc.get("DispatchWorkers") else None))
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)

@@ -1,0 +1,311 @@
+"""Commit batching + post-lock dispatch ring + coalesced fan-out
+(the group-commit cycle: one WAL barrier, vectorized admission, wide
+per-craned pushes)."""
+
+import time
+
+from cranesched_tpu.craned import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    PendingReason,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.rpc import crane_pb2 as pb
+from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+
+def build(num_nodes=4, cpu=8, config=None, wal=None, sim=True):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=cpu, mem_bytes=64 << 30,
+                                         memsw_bytes=64 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(
+        meta, config or SchedulerConfig(priority_type="basic"),
+        wal=wal)
+    cluster = None
+    if sim:
+        cluster = SimCluster(sched)
+        sched.dispatch = cluster.dispatch
+        sched.dispatch_terminate = cluster.terminate
+    return meta, sched, cluster
+
+
+def spec(cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+# ---------------- dispatch ring ----------------
+
+
+def test_ring_batches_in_commit_order_with_captured_identity():
+    meta, sched, cluster = build(num_nodes=4)
+    batches = []
+    sched.dispatch_batch = lambda items: batches.append(list(items))
+    for _ in range(4):
+        sched.submit(spec(cpu=8.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert len(started) == 4
+    # the whole cycle's dispatches went out as ONE batch, in commit
+    # order, each entry carrying the identity captured under the lock
+    assert len(batches) == 1
+    items = batches[0]
+    assert [it[0].job_id for it in items] == started
+    for job, node_ids, incarnation, epoch, _seq in items:
+        assert node_ids == job.node_ids
+        assert incarnation == job.requeue_count
+        assert epoch == sched.fencing_epoch
+
+
+def test_ring_entries_wait_for_durability_watermark(tmp_path):
+    # ring entries are stamped with the WAL seq at queue time; the
+    # drain refuses entries past durable_seq (a failed barrier must
+    # not let the push escape)
+    wal = WriteAheadLog(str(tmp_path / "ctld.wal"))
+    meta, sched, cluster = build(wal=wal)
+    jid = sched.submit(spec(), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [jid]
+    assert not sched._dispatch_ring       # drained post-flush
+    assert wal.durable_seq == wal.seq     # cycle left nothing buffered
+    wal.close()
+
+
+def test_preemption_dispatch_rides_the_ring():
+    from cranesched_tpu.ctld.accounting import (
+        Account, AccountManager, AdminLevel, Qos, User)
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="low", priority=0))
+    mgr.add_qos("root", Qos(name="high", priority=1000,
+                            preempt={"low"}))
+    mgr.add_account("root", Account(name="hpc",
+                                    allowed_qos={"low", "high"},
+                                    default_qos="low"))
+    mgr.add_user("root", User(name="alice", uid=1), "hpc")
+    meta = MetaContainer()
+    meta.add_node("cn00",
+                  meta.layout.encode(cpu=8, mem_bytes=64 << 30,
+                                     memsw_bytes=64 << 30,
+                                     is_capacity=True))
+    meta.craned_up(0)
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=False, preempt_mode="requeue"), accounts=mgr)
+    cluster = SimCluster(sched)
+    sched.dispatch = cluster.dispatch
+    sched.dispatch_terminate = cluster.terminate
+
+    def hpc_spec(qos, runtime):
+        return spec(cpu=8.0, runtime=runtime, user="alice",
+                    account="hpc", qos=qos)
+
+    lo = sched.submit(hpc_spec("low", 500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    dispatched = []
+    orig = sched.dispatch
+    sched.dispatch = lambda job, nodes: (
+        dispatched.append(job.job_id), orig(job, nodes))
+    hi = sched.submit(hpc_spec("high", 10.0), now=1.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(hi).status == JobStatus.RUNNING
+    assert hi in dispatched               # preemptor pushed post-lock
+    assert sched.job_info(lo).status == JobStatus.PENDING
+
+
+def test_empty_cycle_still_flushes_wal_group(tmp_path):
+    # the early-return path (no candidates) must flush the prelude
+    # group: the completion drained by this cycle's prelude cannot sit
+    # buffered across cycles with no durability barrier
+    wal = WriteAheadLog(str(tmp_path / "ctld.wal"))
+    meta, sched, cluster = build(wal=wal)
+    jid = sched.submit(spec(runtime=1.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    cluster.advance_to(2.0)
+    # pending queue empty -> the cycle early-returns after the prelude
+    sched.schedule_cycle(now=3.0)
+    assert sched.job_info(jid).status == JobStatus.COMPLETED
+    assert wal.durable_seq == wal.seq
+    wal.close()
+    ev, job = WriteAheadLog.replay(str(tmp_path / "ctld.wal"))[jid]
+    assert job.status == JobStatus.COMPLETED
+
+
+# ---------------- vectorized commit parity ----------------
+
+
+def test_batched_commit_keeps_license_admission_order():
+    meta, sched, cluster = build(num_nodes=4)
+    sched.licenses.configure("matlab", 2)
+    a = sched.submit(spec(licenses={"matlab": 2}), now=0.0)
+    b = sched.submit(spec(licenses={"matlab": 2}), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [a]
+    assert sched.job_info(b).pending_reason == PendingReason.LICENSE
+
+
+def test_batched_commit_voids_placement_on_dirty_node():
+    # a node event logged mid-cycle voids placements touching it; the
+    # vectorized dirty-row pre-pass must match the old per-job check
+    meta, sched, cluster = build(num_nodes=2)
+    a = sched.submit(spec(cpu=8.0), now=0.0)
+    b = sched.submit(spec(cpu=8.0), now=0.0)
+    gen = sched.cycle_phases(now=0.0)
+    fn = next(gen)
+    downed = False
+    try:
+        while True:
+            result = fn()
+            if not downed:
+                # first solve done: node 0 dies before the commit
+                # resumes — its reduce event lands in the cycle's
+                # logging window and must void placements touching it
+                downed = True
+                sched.on_craned_down(0, now=0.5)
+            fn = gen.send(result)
+    except StopIteration as stop:
+        started = stop.value or []
+    for jid in started:
+        assert 0 not in sched.job_info(jid).node_ids
+    voided = [j for j in (a, b) if j not in started]
+    assert voided   # the placement on the dead node did not commit
+    for jid in voided:
+        assert sched.job_info(jid).status == JobStatus.PENDING
+
+
+def test_batched_malloc_matches_sequential_admission():
+    # entries are admitted in order against the same ledger the
+    # per-job calls would see: 3 jobs of 4 cpus on one 8-cpu node ->
+    # exactly the first two start
+    meta, sched, cluster = build(num_nodes=1)
+    jobs = [sched.submit(spec(cpu=4.0), now=0.0) for _ in range(3)]
+    started = sched.schedule_cycle(now=0.0)
+    assert set(started) <= set(jobs) and len(started) == 2
+    node = meta.nodes[0]
+    assert node.avail[0] == node.total[0] - 2 * 4 * 256
+
+
+# ---------------- coalesced grpc fan-out ----------------
+
+
+class FakeStub:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.calls = []
+
+    def call(self, name, request, reply_cls=None):
+        self.calls.append((name, request.job_id))
+        if self.fail and name in ("ExecuteStep", "AllocJob"):
+            return pb.OkReply(ok=False, error="node exploded")
+        return pb.OkReply(ok=True)
+
+    def close(self):
+        pass
+
+
+def _drain_pool(disp):
+    disp._pool.shutdown(wait=True)
+
+
+def test_dispatch_batch_coalesces_per_node():
+    meta, sched, _ = build(num_nodes=2, sim=False)
+    disp = GrpcDispatcher(sched, max_workers=4)
+    stubs = {i: FakeStub() for i in range(2)}
+    disp._stubs.update(stubs)
+    node_batches = []
+    orig = disp._push_node_batch
+    disp._push_node_batch = lambda entries: (
+        node_batches.append([e[1] for e in entries]), orig(entries))
+    disp.wire(sched)
+    jobs = [sched.submit(spec(cpu=2.0), now=0.0) for _ in range(6)]
+    started = sched.schedule_cycle(now=0.0)
+    assert len(started) == 6
+    _drain_pool(disp)
+    # one pool task per craned, not per job: every batch is homogeneous
+    # in node and the batch count equals the distinct nodes used
+    used = {n for jid in started for n in sched.job_info(jid).node_ids}
+    assert len(node_batches) == len(used)
+    for batch in node_batches:
+        assert len(set(batch)) == 1
+    # every started job got exactly one push on each of its nodes
+    pushed = [jid for stub in stubs.values()
+              for name, jid in stub.calls if name == "ExecuteStep"]
+    assert sorted(pushed) == sorted(started)
+
+
+def test_dispatch_batch_failure_rolls_back_whole_job():
+    meta, sched, _ = build(num_nodes=2, cpu=4, sim=False)
+    disp = GrpcDispatcher(sched, max_workers=2)
+    good, bad = FakeStub(), FakeStub(fail=True)
+    disp._stubs.update({0: good, 1: bad})
+    disp.wire(sched)
+    jid = sched.submit(JobSpec(
+        res=ResourceSpec(cpu=4.0, mem_bytes=1 << 30,
+                         memsw_bytes=1 << 30),
+        node_num=2, sim_runtime=50.0), now=0.0)
+    started = sched.schedule_cycle(now=0.0)
+    assert started == [jid]
+    _drain_pool(disp)
+    # the failing node triggered a whole-job rollback: both nodes see
+    # the terminate and the job fails through the status-change path
+    for stub in (good, bad):
+        assert any(name == "TerminateStep" for name, _ in stub.calls)
+    sched.process_status_changes()
+    assert sched.job_info(jid).status == JobStatus.FAILED
+
+
+def test_default_workers_scales_with_cluster():
+    assert GrpcDispatcher.default_workers(10) == 8
+    assert GrpcDispatcher.default_workers(1024) == 16
+    assert GrpcDispatcher.default_workers(100_000) == 128
+
+
+def test_dispatch_workers_yaml_knob_threads_through(tmp_path):
+    from cranesched_tpu.utils.config import CraneConfig, NodeConfig
+    cfg = CraneConfig(
+        nodes=[NodeConfig(names=["n0"], cpu=4.0,
+                          mem_bytes=1 << 30,
+                          partitions=["default"])],
+        scheduler={"DispatchWorkers": 5})
+    meta, sched = cfg.build()
+    assert sched.config.dispatch_workers == 5
+    disp = GrpcDispatcher(sched)
+    assert disp.max_workers == 5
+    disp.close()
+    # unset: derived from cluster size
+    cfg2 = CraneConfig(
+        nodes=[NodeConfig(names=["n0"], cpu=4.0,
+                          mem_bytes=1 << 30,
+                          partitions=["default"])])
+    meta2, sched2 = cfg2.build()
+    assert sched2.config.dispatch_workers is None
+    disp2 = GrpcDispatcher(sched2)
+    assert disp2.max_workers == 8
+    disp2.close()
+
+
+def test_phase_accounting_splits_commit_and_dispatch():
+    meta, sched, cluster = build(num_nodes=2)
+    slow = []
+
+    def slow_dispatch(items):
+        time.sleep(0.02)
+        slow.extend(items)
+    sched.dispatch_batch = slow_dispatch
+    sched.submit(spec(cpu=8.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    trace = sched.cycle_trace.snapshot()[-1]
+    assert trace["dispatch_ms"] >= 20.0
+    # the slow push is NOT billed to the lock-held phases
+    assert abs(trace["lock_held_ms"]
+               - (trace["prelude_ms"] + trace["commit_ms"])) < 0.01
+    assert trace["commit_ms"] < trace["dispatch_ms"]
+    assert len(slow) == 1

@@ -323,3 +323,129 @@ def test_kill_during_compact_leaves_log_replayable(tmp_path,
     assert set(final) == {j2}
     assert final[j2][1].status == JobStatus.RUNNING
     wal3.close()
+
+
+# ---- group commit: one durability barrier per batch ----
+
+
+def test_group_commit_single_fsync_contiguous_seqs(tmp_path):
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    f0, g0 = wal.fsync_total, wal.groups_total
+    with wal.group():
+        j1 = sched.submit(spec(), now=0.0)
+        j2 = sched.submit(spec(), now=0.0)
+        j3 = sched.submit(spec(), now=0.0)
+        # buffered: nothing durable yet, the replication feed sees none
+        assert wal.seq == 3 and wal.durable_seq == 0
+        assert wal.tail_since(0) == []
+    # ONE fsync covered all three records; seqs stayed contiguous
+    assert wal.fsync_total - f0 == 1
+    assert wal.groups_total - g0 == 1
+    assert wal.durable_seq == wal.seq == 3
+    assert [s for s, _ in wal.tail_since(0)] == [1, 2, 3]
+    wal.close()
+    assert set(WriteAheadLog.replay(path)) == {j1, j2, j3}
+
+
+def test_crash_tears_group_tail_replay_discards(tmp_path):
+    # kill between the group's write and its fsync: the OS may have
+    # persisted a PREFIX of the batch with a torn final line — replay
+    # applies the complete lines and discards the torn one, and a
+    # reopened WAL resumes from the durable prefix
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    j1 = sched.submit(spec(), now=0.0)
+    with wal.group():
+        j2 = sched.submit(spec(), now=0.0)
+        sched.submit(spec(), now=0.0)
+    wal.close()
+    lines = open(path).read().splitlines(True)
+    with open(path, "w") as fh:     # tear the group's final record
+        fh.writelines(lines[:-1])
+        fh.write(lines[-1][: len(lines[-1]) // 2])
+    assert set(WriteAheadLog.replay(path)) == {j1, j2}
+    wal2 = WriteAheadLog(path)
+    assert wal2.seq == 2 == wal2.durable_seq
+    assert wal2.tail_since(0) is None    # fresh open: resync
+    wal2.close()
+
+
+def test_failed_group_fsync_blocks_dispatch(tmp_path, monkeypatch):
+    # the acceptance contract: NO dispatch for any job in a group until
+    # the group's fsync returns.  Kill the barrier itself and assert
+    # the committed job is never pushed to the node plane.
+    import pytest
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    dispatched = []
+    sched.dispatch = lambda job, nodes: dispatched.append(job.job_id)
+    sched.submit(spec(), now=0.0)
+    monkeypatch.setattr(
+        "cranesched_tpu.ctld.wal.os.fsync",
+        lambda fd: (_ for _ in ()).throw(OSError("disk gone")))
+    with pytest.raises(OSError):
+        sched.schedule_cycle(now=1.0)
+    assert dispatched == []          # durable-before-dispatch held
+
+
+def test_compact_mid_group_flushes_buffer_first(tmp_path, monkeypatch):
+    # auto-compaction can fire while the cycle's commit group is open
+    # (finalize count trips inside process_status_changes): the
+    # buffered records must hit disk BEFORE the rewrite, or the
+    # compacted file silently loses them
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    j1 = sched.submit(spec(), now=0.0)
+    wal.begin_batch()
+    j2 = sched.submit(spec(), now=0.0)   # buffered
+    wal.compact()                        # must flush j2's record first
+    j3 = sched.submit(spec(), now=0.0)   # group still open: buffered
+    wal.commit_batch()
+    wal.close()
+    assert set(WriteAheadLog.replay(path)) == {j1, j2, j3}
+
+    # crash DURING the mid-group compact (before the rename lands):
+    # the pre-flush made the buffered record durable in the OLD file
+    path2 = str(tmp_path / "crash.wal")
+    wal2 = WriteAheadLog(path2)
+    meta2, sched2, _ = build(tmp_path, wal=wal2)
+    k1 = sched2.submit(spec(), now=0.0)
+    wal2.begin_batch()
+    k2 = sched2.submit(spec(), now=0.0)
+    monkeypatch.setattr("cranesched_tpu.ctld.wal.os.replace",
+                        lambda s, d: (_ for _ in ()).throw(
+                            OSError("kill -9 mid-compact")))
+    try:
+        wal2.compact()
+    except OSError:
+        pass
+    monkeypatch.undo()
+    assert set(WriteAheadLog.replay(path2)) == {k1, k2}
+
+
+def test_follower_tail_parity_across_group_boundary(tmp_path):
+    # HaFetchWal parity: a follower cursoring across a group boundary
+    # sees the exact record stream with contiguous seqs — group commit
+    # must be invisible to replication (same wire format, same order)
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    meta, sched, cluster = build(tmp_path, wal=wal)
+    sched.submit(spec(), now=0.0)          # singleton append (seq 1)
+    with wal.group():
+        sched.submit(spec(), now=0.0)      # seq 2
+        sched.submit(spec(), now=0.0)      # seq 3
+    sched.submit(spec(), now=0.0)          # singleton append (seq 4)
+    # cursor mid-group-boundary: picks up exactly the group's records
+    assert [s for s, _ in wal.tail_since(1)] == [2, 3, 4]
+    assert [s for s, _ in wal.tail_since(2)] == [3, 4]
+    # every handed-out record parses and carries its seq (wire parity)
+    for s, line in wal.tail_since(0):
+        assert json.loads(line)["seq"] == s
+    assert wal.tail_since(4) == []         # caught up
+    assert wal.tail_since(99) is None      # diverged: resync
+    wal.close()

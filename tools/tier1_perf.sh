@@ -13,6 +13,7 @@ cd "$(dirname "$0")/.."
 out=$(timeout -k 10 600 env JAX_PLATFORMS=cpu \
   BENCH_JOBS=2048 BENCH_NODES=256 BENCH_REPEATS=2 BENCH_SOLVER=native \
   BENCH_SCHED_JOBS=2048 BENCH_SCHED_NODES=256 \
+  BENCH_COMMIT_JOBS=2048 BENCH_COMMIT_NODES=256 \
   python bench.py)
 echo "$out"
 python - "$out" <<'PY'
@@ -25,5 +26,20 @@ assert sc and "error" not in sc, f"sched_cycle measurement failed: {sc}"
 share = sc["prelude_share"]
 assert share <= 0.25, (
     f"prelude is {share:.1%} of cycle wall time (limit 25%): {sc}")
-print(f"TIER1_PERF_OK prelude_share={share:.3f} solver={sc['solver']}")
+# the group-commit guard: total LOCK-HELD time (prelude + commit, never
+# the solve or the post-lock dispatch drain) must stay a minority share
+# of the cycle — a regression that drags fsyncs or pushes back under
+# the lock shows up here
+lock_share = sc["lock_held_share"]
+assert lock_share <= 0.35, (
+    f"lock-held (prelude+commit) is {lock_share:.1%} of cycle wall "
+    f"time (limit 35%): {sc}")
+cb = doc["detail"]["commit"]
+assert cb and "error" not in cb, f"commit bench failed: {cb}"
+assert cb["fsyncs_equal_groups"] and cb["groups_le_3"], (
+    f"group commit broke its fsync amortization contract: {cb}")
+print(f"TIER1_PERF_OK prelude_share={share:.3f} "
+      f"lock_held_share={lock_share:.3f} "
+      f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
+      f"solver={sc['solver']}")
 PY
