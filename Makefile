@@ -19,8 +19,12 @@
 #              and dispatch-ring tests only — seconds, not minutes.
 #              Use while iterating on wal.py, _commit, or the
 #              dispatcher fan-out.
+# tier1-topo — topology lane (@pytest.mark.topo in
+#              tests/test_topo_place.py): best-fit-block solve vs the
+#              numpy oracle, permutation equivalence, and the scheduler
+#              e2e on torus/explicit-tree topologies.
 
-.PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit
+.PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo
 
 tier1:
 	bash tools/tier1.sh
@@ -40,4 +44,8 @@ tier1-commit:
 	env JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_wal_recovery.py tests/test_commit_dispatch.py \
 	  -q -m "not slow" \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-topo:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m topo \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

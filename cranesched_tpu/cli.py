@@ -558,8 +558,51 @@ def cmd_cqueue(args) -> int:
     return 0
 
 
+def _cinfo_topo(client) -> int:
+    """Interconnect tree view from the QueryStats topology section."""
+    import json as _json
+    doc = _json.loads(client.query_stats().json)
+    topo = doc.get("topology")
+    if not topo:
+        print("cinfo: no topology configured", file=sys.stderr)
+        return 1
+    levels = topo.get("levels") or []
+    leaf = levels[0] if levels else {"groups": []}
+    frag = leaf.get("fragmentation")
+    frag_s = "-" if frag is None else f"{frag:.3f}"
+    print(f"cluster  {topo.get('num_nodes')} nodes  "
+          f"{topo.get('num_blocks')} blocks  frag={frag_s}")
+
+    def _leaf_line(grp, indent):
+        free = grp.get("free")
+        free_s = "-" if free is None else str(free)
+        print(f"{indent}├─ {grp['name']}  {grp['size']} nodes  "
+              f"free={free_s}")
+
+    if len(levels) > 1:
+        for upper in levels[1]["groups"]:
+            ufree = upper.get("free")
+            print(f"└─ {levels[1]['name']} {upper['name']}  "
+                  f"{upper['size']} nodes  "
+                  f"free={'-' if ufree is None else ufree}")
+            for grp in leaf["groups"]:
+                if grp.get("parent") == upper["name"]:
+                    _leaf_line(grp, "   ")
+        orphans = [g for g in leaf["groups"] if g.get("parent") is None]
+        if orphans:
+            print("└─ (no switch)")
+            for grp in orphans:
+                _leaf_line(grp, "   ")
+    else:
+        for grp in leaf["groups"]:
+            _leaf_line(grp, "")
+    return 0
+
+
 def cmd_cinfo(args) -> int:
     client = _client(args)
+    if getattr(args, "topo", False):
+        return _cinfo_topo(client)
     reply = client.query_cluster()
     rows = []
     for n in reply.nodes:
@@ -662,12 +705,12 @@ def cmd_cstats(args) -> int:
                  t.get("prelude_ms"), t.get("solve_ms"),
                  t.get("commit_ms"), t.get("dispatch_ms"),
                  t.get("lock_held_ms"), t.get("total_ms"),
-                 t.get("wal_fsyncs"))
+                 t.get("wal_fsyncs"), t.get("topo_frag", "-"))
                 for t in doc.get("cycle_trace", [])]
         print(_fmt_table(rows, (
             "NOW", "SOLVER", "QUEUE", "CAND", "PLACED", "BACKFILL",
             "PREEMPT", "PRELUDE_MS", "SOLVE_MS", "COMMIT_MS",
-            "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC")))
+            "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC", "FRAG")))
         return 0
     if getattr(args, "metrics", False):
         rows = []
@@ -1053,6 +1096,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_cqueue)
 
     p = sub.add_parser("cinfo", help="show cluster nodes")
+    p.add_argument("--topo", action="store_true",
+                   help="render the interconnect topology tree "
+                        "(blocks/switches, free nodes, fragmentation)")
     p.set_defaults(func=cmd_cinfo)
 
     p = sub.add_parser("ccancel", help="cancel jobs")

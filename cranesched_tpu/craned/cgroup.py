@@ -68,7 +68,13 @@ def _kill_pids(procs_file: str) -> bool:
             pids = [int(p) for p in fh.read().split()]
     except (OSError, ValueError):
         return False
+    me = os.getpid()
     for pid in pids:
+        # a procs file can name this very process (PAM ADOPT takes any
+        # caller-supplied pid, and in-process daemons share the test
+        # runner's pid) — cgroup teardown must never be suicide
+        if pid <= 1 or pid == me:
+            continue
         try:
             os.kill(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -336,6 +336,11 @@ class Job:
     run_usage_taken: bool = dataclasses.field(
         default=False, repr=False, compare=False)
     priority: float = 0.0
+    # topology placement record (topo/): the leaf block name when the
+    # gang landed inside one block, "" otherwise; cross_block marks the
+    # spanning fallback (exported as crane_topo_cross_block_gangs_total)
+    topo_block: str = ""
+    cross_block: bool = False
 
     def reset_for_requeue(self) -> None:
         """Return to pending after a failure/node-death (reference

@@ -68,6 +68,10 @@ class NodeMeta:
     # must not clear a maintenance drain
     health_drained: bool = False
     health_message: str = ""          # last health-check report
+    # interconnect position, stamped by MetaContainer.set_topology():
+    # top-down group-name path (e.g. (switch, block)) and torus coords
+    block_path: tuple = ()
+    coords: tuple | None = None
 
     @property
     def schedulable(self) -> bool:
@@ -132,8 +136,11 @@ class MetaContainer:
         self.reservations: dict[str, Reservation] = {}
         # bumped on any reservation change so mask caches invalidate
         self.resv_epoch = 0
+        # interconnect topology (topo.model.Topology), attached via
+        # set_topology() once the node registry is complete
+        self.topology = None
 
-    # ---- topology ----
+    # ---- partitions & node registry ----
 
     def add_partition(self, name: str, priority: int = 0,
                       allowed_accounts: Iterable[str] | None = None,
@@ -179,6 +186,50 @@ class MetaContainer:
                 out = np.maximum(out, self.nodes[i].total)
         self._part_max_cache[partition] = out
         return out
+
+    def update_node_total(self, node_id: int, new_total: np.ndarray) -> bool:
+        """Apply a changed node capacity (dynamic craned re-registration
+        with different hardware/cgroup limits).  ``avail`` moves by the
+        delta so running allocations stay charged, and the per-partition
+        max-total cache is invalidated — without that, a node
+        re-registering with more (or fewer) resources would leave
+        ``partition_max_total`` stale and submit-time feasibility wrong.
+        Returns True iff the total actually changed."""
+        node = self.nodes[node_id]
+        new_total = np.asarray(new_total, np.int32)
+        if new_total.shape != node.total.shape:
+            raise ValueError(
+                f"total shape {new_total.shape} != {node.total.shape}")
+        if (new_total == node.total).all():
+            return False
+        delta = new_total - node.total
+        shrank = bool((delta < 0).any())
+        node.total = new_total
+        node.avail = np.minimum(node.avail + delta, new_total)
+        if shrank:
+            # a shrink can invalidate an in-flight cycle's placements,
+            # same as a node death — force commit-time revalidation
+            self._log_event(ResReduceEvent(node_id))
+        for p in node.partitions:
+            self._part_max_cache.pop(p, None)
+        return True
+
+    # ---- interconnect topology (topo.model.Topology) ----
+
+    def set_topology(self, topology) -> None:
+        """Attach the interconnect topology and stamp each node's
+        ``block_path``/``coords``.  Topology node ids must line up with
+        the registry (build it after all nodes are added)."""
+        if topology.num_nodes != len(self.nodes):
+            raise ValueError(
+                f"topology covers {topology.num_nodes} nodes but the "
+                f"registry has {len(self.nodes)}")
+        self.topology = topology
+        for nid, node in self.nodes.items():
+            node.block_path = topology.block_path(nid)
+            node.coords = (
+                tuple(int(c) for c in topology.coords[nid])
+                if topology.coords is not None else None)
 
     # ---- reservations (reference CreateReservation handling +
     #      reservation scheduling domains, JobScheduler.cpp:6624-6732) ----

@@ -70,6 +70,7 @@ from cranesched_tpu.models.solver_time import (
 )
 from cranesched_tpu.obs import REGISTRY as _OBS
 from cranesched_tpu.obs.trace import CycleTraceRing, solve_span
+from cranesched_tpu.topo.place import solve_greedy_topo
 from cranesched_tpu.ops.resources import CPU_SCALE, DIM_CPU, DIM_MEM
 
 # cycle-plane metrics (naming: ARCHITECTURE.md "Observability")
@@ -94,6 +95,13 @@ _MET_PREEMPTED = _OBS.counter(
     "crane_preempted_total", "running jobs evicted by preemption")
 _MET_PENDING = _OBS.gauge(
     "crane_pending_jobs", "pending queue depth at cycle start")
+_MET_TOPO_FRAG = _OBS.gauge(
+    "crane_topo_fragmentation",
+    "free-capacity fragmentation per topology level "
+    "(1 - largest free group / total free; label level)")
+_MET_TOPO_CROSS = _OBS.counter(
+    "crane_topo_cross_block_gangs_total",
+    "gangs placed across blocks by the spanning fallback")
 
 _REASON_MAP = {
     REASON_RESOURCE: PendingReason.RESOURCE,
@@ -1783,6 +1791,36 @@ class JobScheduler:
                 self._note_dispatch((yield self._dispatch_phase()))
             return started
 
+        topo = self._active_topology()
+        if topo is not None:
+            self._update_topo_fragmentation(topo, avail, total, alive)
+        if topo is not None and any(j.spec.node_num > 1 for j in ordered):
+            # gang cycle with a topology configured: route through the
+            # best-fit-block solve (topo/place.py).  Backfill is skipped
+            # for this cycle — locality dominates reservation lookahead
+            # for gangs, and single-node cycles keep the full backfill
+            # path (plus the block-major permutation, see
+            # _immediate_solve).
+            state = make_cluster_state(avail, total, alive, cost0)
+            dense = (jobs_batch.dense
+                     if isinstance(jobs_batch, FactoredJobBatch)
+                     else jobs_batch)
+            levels = topo.jnp_levels
+            self._wal_flush()
+            placements, _, topo_info = yield self._traced_solve(
+                "topo", lambda: solve_greedy_topo(
+                    state, dense, levels, max_nodes=max_nodes))
+            self._wal_begin()
+            self._note_topo(topo, ordered, topo_info)
+            started = self._commit(ordered, placements, now)
+            started += self._try_preemption(ordered, now)
+            self._wal_flush()
+            self._record_cycle_stats(t0, t_prelude, candidates, started,
+                                     _time.perf_counter(), "topo")
+            if self._dispatch_ring:
+                self._note_dispatch((yield self._dispatch_phase()))
+            return started
+
         if self.config.backfill:
             bf_max = max(1, self.config.backfill_max_jobs)
             if len(ordered) > bf_max:
@@ -1830,7 +1868,22 @@ class JobScheduler:
     def _immediate_solve(self, avail, total, alive, cost0, jobs_batch,
                          max_nodes):
         """Route one immediate-fit solve through the configured backend
-        (auto/native/device/pallas/sharded — all bit-identical)."""
+        (auto/native/device/pallas/sharded — all bit-identical).
+
+        When a topology is configured, the node axis is presented to the
+        backend in block-major order (Topology.perm): the backends'
+        ascending-cost / first-fit walks then cluster picks inside
+        blocks — locality with zero kernel changes — and the chosen
+        indices are mapped back to real node ids before commit."""
+        topo = self._active_topology()
+        perm = None
+        if topo is not None:
+            perm = topo.perm
+            avail = np.asarray(avail)[perm]
+            total = np.asarray(total)[perm]
+            alive = np.asarray(alive)[perm]
+            cost0 = np.asarray(cost0)[perm]
+            jobs_batch = self._permute_batch(jobs_batch, topo)
         placements = None
         solver_name = "immediate"
         if self.config.solver in ("auto", "native"):
@@ -1854,7 +1907,77 @@ class JobScheduler:
                      else jobs_batch)
             placements, _ = solve_greedy(state, dense,
                                          max_nodes=max_nodes)
+        if perm is not None:
+            nodes = np.asarray(placements.nodes)
+            real = np.where(nodes >= 0, perm[np.maximum(nodes, 0)],
+                            np.int32(-1)).astype(np.int32)
+            placements = Placements(placed=np.asarray(placements.placed),
+                                    nodes=real,
+                                    reason=np.asarray(placements.reason))
         return placements, solver_name
+
+    # ---- topology-aware placement (topo/) ----
+
+    def _active_topology(self):
+        """The attached Topology, or None when absent/stale (nodes
+        registered after it was built — size mismatch means its arrays
+        no longer line up with the snapshot)."""
+        topo = getattr(self.meta, "topology", None)
+        if topo is not None and topo.num_nodes != len(self.meta.nodes):
+            return None
+        return topo
+
+    def _permute_batch(self, jobs_batch, topo):
+        """Job batch with the node axis in block-major order."""
+        jperm = topo.jnp_perm
+        if isinstance(jobs_batch, FactoredJobBatch):
+            node_class = jobs_batch.node_class_np
+            return FactoredJobBatch(
+                req=jobs_batch.req, node_num=jobs_batch.node_num,
+                time_limit=jobs_batch.time_limit, valid=jobs_batch.valid,
+                job_class=jobs_batch.job_class,
+                class_masks=jobs_batch.class_masks[:, jperm],
+                job_class_np=jobs_batch.job_class_np,
+                class_rows_np=np.asarray(
+                    jobs_batch.class_rows_np)[:, topo.perm],
+                node_class_np=(np.asarray(node_class)[topo.perm]
+                               if node_class is not None else None))
+        return jobs_batch.replace(part_mask=jobs_batch.part_mask[:, jperm])
+
+    def _update_topo_fragmentation(self, topo, avail, total, alive):
+        """Per-level free-capacity fragmentation gauge + trace field,
+        computed from the cycle snapshot (a free node is alive with its
+        full capacity available)."""
+        free = alive & (avail == total).all(axis=1)
+        frags = topo.fragmentation(free)
+        for name, frag in frags:
+            _MET_TOPO_FRAG.set(frag, level=name)
+        self._cur_trace["topo_frag"] = frags[0][1]
+
+    def _note_topo(self, topo, ordered, info) -> None:
+        """Record per-gang locality verdicts: trace fields, the
+        cross-block counter, and each job's topo_block/cross_block."""
+        import jax as _jax
+        info = _jax.device_get(info)  # one transfer for all three
+        in_b = info.in_block.tolist()
+        crs = info.cross.tolist()
+        blocks = info.block.tolist()
+        n_in = sum(in_b)
+        n_cross = sum(crs)
+        self._cur_trace["topo_in_block"] = n_in
+        self._cur_trace["topo_cross"] = n_cross
+        self.stats["topo_in_block_total"] = (
+            self.stats.get("topo_in_block_total", 0) + n_in)
+        self.stats["topo_cross_block_total"] = (
+            self.stats.get("topo_cross_block_total", 0) + n_cross)
+        if n_cross:
+            _MET_TOPO_CROSS.inc(n_cross)
+        for i, job in enumerate(ordered):
+            job.cross_block = bool(crs[i])
+            job.topo_block = (
+                topo.block_names[int(blocks[i])]
+                if in_b[i] and blocks[i] >= 0
+                else ("spanning" if crs[i] else ""))
 
     def _split_backfill_phases(self, ordered, jobs_batch, avail, total,
                                alive, cost0, max_nodes, now):

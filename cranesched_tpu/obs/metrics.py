@@ -12,10 +12,14 @@ Naming scheme (ARCHITECTURE.md "Observability"):
 
 e.g. ``crane_cycle_phase_seconds`` (histogram, label phase=prelude|
 solve|commit), ``crane_rpc_latency_seconds`` (histogram, label method),
-``crane_craned_state`` (gauge, 0..3 FSM ordinal).  ``*_total`` are
-monotonic counters; ``*_seconds`` histograms use the shared log-scale
-buckets below (100 µs .. ~100 s), which cover both RPC latencies and
-multi-second TPU solves without per-metric tuning.
+``crane_craned_state`` (gauge, 0..3 FSM ordinal),
+``crane_topo_fragmentation`` (gauge, label level — per-topology-level
+free-capacity fragmentation) and
+``crane_topo_cross_block_gangs_total`` (counter — gangs placed by the
+cross-block spanning fallback).  ``*_total`` are monotonic counters;
+``*_seconds`` histograms use the shared log-scale buckets below
+(100 µs .. ~100 s), which cover both RPC latencies and multi-second
+TPU solves without per-metric tuning.
 """
 
 from __future__ import annotations

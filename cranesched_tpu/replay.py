@@ -17,6 +17,8 @@ Configs (full-scale shapes from BASELINE.md):
   gres        GRES gang jobs (gpu slots + multi-node gangs)
   qos         QoS/fair-share mix with run limits (scaled from the 1M
               trace shape)
+  topo        gang-heavy mix on a generated torus (topology-aware
+              best-fit-block placement; not part of BASELINE.json)
 """
 
 from __future__ import annotations
@@ -271,12 +273,50 @@ def replay_qos(scale: float, rng, run=_run_direct):
     return run(sched, sim, specs, max_cycles=200_000)
 
 
+def replay_topo(scale: float, rng, run=_run_direct):
+    """Locality config (topo/): gang-heavy mix on a generated torus
+    carved into aligned sub-tori (TPU v4-style slices), exercising the
+    best-fit-block solve + cross-block fallback end to end."""
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    from cranesched_tpu.topo.model import Topology
+    # torus shapes must stay slice-aligned, so scale picks a shape
+    # instead of multiplying node counts
+    if scale >= 0.5:
+        shape, slice_shape = (8, 8, 8), (4, 4, 4)    # 512 nodes, 8 blocks
+    else:
+        shape, slice_shape = (4, 4, 4), (2, 2, 2)    # 64 nodes, 8 blocks
+    n_nodes = int(np.prod(shape))
+    n_jobs = max(int(2000 * scale), 30)
+    meta, sched, sim = _build(
+        n_nodes, cpu=32, mem_gb=128,
+        config_kw=dict(priority_type="multifactor", backfill=False,
+                       max_nodes_per_job=8))
+    meta.set_topology(Topology.from_torus(shape, slice_shape))
+    specs = []
+    for _ in range(n_jobs):
+        gang = rng.random() < 0.6
+        specs.append(JobSpec(
+            res=ResourceSpec(cpu=float(rng.integers(1, 9)),
+                             mem_bytes=int(rng.integers(1, 17)) << 30,
+                             memsw_bytes=int(rng.integers(1, 17)) << 30),
+            node_num=int(rng.integers(2, 9)) if gang else 1,
+            time_limit=3600,
+            sim_runtime=float(rng.integers(30, 300))))
+    out = run(sched, sim, specs)
+    out["topo_in_block_gangs"] = int(
+        sched.stats.get("topo_in_block_total", 0))
+    out["topo_cross_block_gangs"] = int(
+        sched.stats.get("topo_cross_block_total", 0))
+    return out
+
+
 CONFIGS = {
     "fifo": replay_fifo,
     "minload": replay_minload,
     "backfill": replay_backfill,
     "gres": replay_gres,
     "qos": replay_qos,
+    "topo": replay_topo,
 }
 
 

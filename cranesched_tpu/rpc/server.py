@@ -546,6 +546,13 @@ class CtldServer:
             # RPC and can flag "scheduler stalled" client-side
             doc["metrics"] = REGISTRY.snapshot()
             doc["cycle_trace"] = self.scheduler.cycle_trace.snapshot()
+            topo = getattr(self.scheduler.meta, "topology", None)
+            if topo is not None:
+                from cranesched_tpu.topo.model import topology_doc
+                avail_np, total_np, alive_np = \
+                    self.scheduler.meta.snapshot()
+                free = alive_np & (avail_np == total_np).all(axis=1)
+                doc["topology"] = topology_doc(topo, free)
             doc["watchdog"] = {
                 "now": time.time(),
                 "cycle_interval": self.cycle_interval,
@@ -719,6 +726,26 @@ class CtldServer:
                     return pb.CranedRegisterReply(
                         ok=False, error="node is powered off "
                                         "(wake it with cnode wake)")
+                # a re-registration may report CHANGED capacity
+                # (hardware swap, cgroup limits): re-encode and apply it
+                # through update_node_total, which also invalidates the
+                # partition max-total cache — skipping this left the
+                # cache stale and submit-time feasibility wrong
+                if request.total.cpu or request.total.mem_bytes:
+                    known = set(meta.layout.gres_dims)
+                    gres = {}
+                    for key, count in request.total.gres.items():
+                        name, _, typ = key.partition(":")
+                        if (name, typ) in known:
+                            gres[(name, typ)] = count
+                    meta.update_node_total(
+                        node.node_id,
+                        meta.layout.encode(
+                            cpu=request.total.cpu,
+                            mem_bytes=request.total.mem_bytes,
+                            memsw_bytes=request.total.memsw_bytes,
+                            gres=gres,
+                            is_capacity=True))
             else:
                 # only GRES pairs in the cluster's configured layout can
                 # be represented; unknown pairs are ignored (the craned

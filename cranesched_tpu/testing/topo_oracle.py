@@ -1,0 +1,136 @@
+"""NumPy oracle for the topology-restricted solve — parity reference.
+
+Independent transcription of ``topo.place.solve_greedy_topo``'s
+semantics in plain Python loops (same relationship to it as
+``testing/oracle.py`` has to ``models.solver.solve_greedy``):
+
+* admission from GLOBAL feasibility counts, exactly solve_greedy's rule;
+* best fit at the leaf level: smallest group size whose feasible count
+  covers the gang, ties → lowest group id;
+* otherwise the lowest upper level with a fitting group bounds the
+  spanning set, and the gang spans the minimal prefix of leaf blocks
+  ordered by (feasible count desc, block id asc);
+* the restriction applies only to gangs (node_num > 1);
+* selection inside the restriction and the int32 fixed-point cost
+  update match testing/oracle.py bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cranesched_tpu.models.solver import (
+    COST_SCALE,
+    REASON_CONSTRAINT,
+    REASON_NONE,
+    REASON_RESOURCE,
+)
+from cranesched_tpu.ops.resources import DIM_CPU
+
+_INF = 2**31 - 1
+
+
+def _fit(feasible, gon, sizes, k):
+    """(have, group, member_mask): smallest group fitting k feasible."""
+    num_groups = len(sizes)
+    counts = np.zeros(num_groups + 1, np.int64)
+    np.add.at(counts, np.where(gon >= 0, gon, num_groups),
+              feasible.astype(np.int64))
+    fits = counts[:num_groups] >= k
+    key = np.where(fits, sizes.astype(np.int64), _INF)
+    g = int(np.argmin(key)) if num_groups else 0
+    if num_groups == 0 or not fits[g]:
+        return False, -1, np.zeros_like(feasible)
+    return True, g, gon == g
+
+
+def _span(feasible, gon, sizes, k):
+    """Minimal leaf-block prefix (count desc, id asc) covering k."""
+    num_groups = len(sizes)
+    counts = np.zeros(num_groups + 1, np.int64)
+    np.add.at(counts, np.where(gon >= 0, gon, num_groups),
+              feasible.astype(np.int64))
+    order = np.argsort(-counts, kind="stable")
+    sorted_counts = counts[order]
+    cum = np.cumsum(sorted_counts)
+    needed = ((cum - sorted_counts) < k) & (sorted_counts > 0)
+    sel = np.zeros(num_groups + 1, bool)
+    sel[order] = needed
+    return sel[np.where(gon >= 0, gon, num_groups)]
+
+
+def solve_greedy_topo_oracle(avail, total, alive, cost, req, node_num,
+                             time_limit, part_mask, valid, max_nodes,
+                             levels):
+    """Same contract as topo.place.solve_greedy_topo, in NumPy.
+
+    ``levels``: leaf-first ``[(group_of_node [N], sizes [G]), ...]``.
+    Returns (placed[J], nodes[J, max_nodes], reason[J], avail', cost',
+    in_block[J], cross[J], block[J]).
+    """
+    avail = np.array(avail, dtype=np.int64)
+    cost = np.round(np.asarray(cost)).astype(np.int64)
+    total = np.asarray(total)
+    alive = np.asarray(alive, bool)
+    levels = [(np.asarray(gon, np.int64), np.asarray(sizes, np.int64))
+              for gon, sizes in levels]
+
+    J = len(req)
+    N = avail.shape[0]
+    placed = np.zeros(J, bool)
+    nodes_out = np.full((J, max_nodes), -1, np.int32)
+    reason = np.zeros(J, np.int32)
+    in_block = np.zeros(J, bool)
+    cross = np.zeros(J, bool)
+    block = np.full(J, -1, np.int32)
+
+    for j in range(J):
+        if not valid[j] or node_num[j] <= 0:
+            reason[j] = REASON_CONSTRAINT
+            continue
+        k = int(node_num[j])
+        eligible = alive & part_mask[j]
+        if k > min(max_nodes, N):
+            reason[j] = (REASON_RESOURCE if eligible.sum() >= k
+                         else REASON_CONSTRAINT)
+            continue
+        feasible = eligible & np.all(req[j][None, :] <= avail, axis=-1)
+        if feasible.sum() < k:
+            reason[j] = (REASON_RESOURCE if eligible.sum() >= k
+                         else REASON_CONSTRAINT)
+            continue
+
+        restrict = np.ones(N, bool)
+        if k > 1:
+            leaf_gon, leaf_sizes = levels[0]
+            have_leaf, g, mask = _fit(feasible, leaf_gon, leaf_sizes, k)
+            if have_leaf:
+                restrict = mask
+                in_block[j] = True
+                block[j] = g
+            else:
+                anc = np.ones(N, bool)
+                for gon, sizes in reversed(levels[1:]):
+                    have, _, mask_l = _fit(feasible, gon, sizes, k)
+                    if have:
+                        anc = mask_l  # lowest fitting ancestor wins
+                restrict = _span(feasible & anc, leaf_gon, leaf_sizes, k)
+                cross[j] = True
+
+        # ascending cost inside the restriction, ties -> lowest index
+        order = np.argsort(np.where(feasible & restrict, cost, _INF),
+                           kind="stable")
+        chosen = order[:k]
+        for n in chosen:
+            avail[n] -= req[j]
+            cpu_total = max(int(total[n, DIM_CPU]), 1)
+            cost[n] += int(np.round(
+                np.float32(time_limit[j])
+                * np.float32(req[j, DIM_CPU]) * np.float32(COST_SCALE)
+                / np.float32(cpu_total)))
+        placed[j] = True
+        nodes_out[j, :k] = chosen
+        reason[j] = REASON_NONE
+
+    return (placed, nodes_out, reason, avail.astype(np.int32), cost,
+            in_block, cross, block)

@@ -1,0 +1,12 @@
+"""Interconnect-topology subsystem.
+
+``topo.model`` describes the cluster's interconnect as a small tree of
+node groups (TPU v4-style sub-tori or Slurm topology.conf-style switch
+blocks); ``topo.place`` is the batched best-fit-block gang solve that
+keeps multi-node jobs inside one ICI domain whenever possible.
+"""
+
+from cranesched_tpu.topo.model import Topology, topology_doc
+from cranesched_tpu.topo.place import TopoInfo, solve_greedy_topo
+
+__all__ = ["Topology", "topology_doc", "TopoInfo", "solve_greedy_topo"]

@@ -1,0 +1,212 @@
+"""Batched best-fit-block gang placement.
+
+``solve_greedy_topo`` is ``models.solver.solve_greedy`` with a
+topology-restriction stage spliced between feasibility and node
+selection.  Per scan step (one job), entirely in fixed-shape vector ops:
+
+1. Segment-sum the job's feasible-node mask into per-group counts at
+   every topology level (the [J,B] feasible-count matrix of the design,
+   materialized one row per scan step so state mutations stay exact).
+2. **Best fit**: at the leaf level pick the group with the smallest
+   ``size`` among those whose feasible count covers the whole gang
+   (ties → lowest group id, matching Slurm topology/tree's
+   smallest-feasible-switch rule).
+3. If no leaf block fits, restrict to the lowest *ancestor* level where
+   some group fits (lowest-common-ancestor spanning), then span the
+   fewest leaf blocks inside it: blocks ordered by descending feasible
+   count (ties → lowest id), minimal prefix covering ``node_num``.
+   Everything outside that spanning set gets the sentinel cost — an
+   infinite cross-block penalty, so the cheapest-k walk cannot leak out.
+4. ``cheapest_k`` over the restricted cost vector, allocation and cost
+   update identical to the base solver.
+
+Single-node jobs (``node_num == 1``) skip the restriction — locality for
+them comes from the block-major permutation (see topo/model.py).
+
+Semantics are deterministic in real node-id order (no hidden permutation)
+so ``testing/topo_oracle.py`` can mirror them bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cranesched_tpu.models.solver import (
+    COST_INF,
+    ClusterState,
+    JobBatch,
+    Placements,
+    apply_placement,
+    cheapest_k,
+    decide_job,
+    job_feasibility,
+)
+
+
+@struct.dataclass
+class TopoInfo:
+    """Per-job placement-locality verdicts, aligned with the job order.
+
+    in_block: bool[J]   gang placed entirely inside one leaf block
+    cross:    bool[J]   gang placed by the cross-block spanning fallback
+    block:    int32[J]  leaf block id when in_block, else -1
+    """
+
+    in_block: jax.Array
+    cross: jax.Array
+    block: jax.Array
+
+
+def _group_onehot(gon, num_groups):
+    """Static int32 [G+1, N] membership matrix; row G = ungrouped.
+
+    Per-step group counts are then ``onehot @ feasible`` — one small
+    matmul instead of a scatter-add, which lowers to a SERIAL scatter
+    on both CPU and TPU and dominated the solve before."""
+    bins = jnp.where(gon >= 0, gon, num_groups)
+    return (bins[None, :] == jnp.arange(num_groups + 1)[:, None]
+            ).astype(jnp.int32)
+
+
+def _level_fit(feasible, onehot, gon, sizes, node_num):
+    """Smallest group at one level that fits the whole gang.
+
+    Returns (have, group_id, member_mask): ``have`` iff some group's
+    feasible count >= node_num; the winner is the smallest ``sizes[g]``,
+    ties to the lowest group id (argmin first-occurrence).
+    """
+    num_groups = sizes.shape[0]
+    counts = onehot @ feasible.astype(jnp.int32)
+    fits = counts[:num_groups] >= node_num
+    key = jnp.where(fits, sizes, jnp.int32(COST_INF))
+    g = jnp.argmin(key).astype(jnp.int32)
+    return fits[g], g, gon == g
+
+
+def _span_mask(feasible, onehot, gon, sizes, node_num):
+    """Minimal leaf-block spanning set: blocks ordered by descending
+    feasible count (stable argsort → ties to the lowest id; the
+    ungrouped pool rides along as one extra pseudo-block), minimal
+    prefix whose cumulative count reaches ``node_num``."""
+    num_groups = sizes.shape[0]
+    counts = onehot @ feasible.astype(jnp.int32)
+    order = jnp.argsort(-counts)
+    sorted_counts = counts[order]
+    cum = jnp.cumsum(sorted_counts)
+    needed = ((cum - sorted_counts) < node_num) & (sorted_counts > 0)
+    sel = jnp.zeros(num_groups + 1, bool).at[order].set(needed)
+    bins = jnp.where(gon >= 0, gon, num_groups)
+    return sel[bins]
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def solve_greedy_topo(state: ClusterState, jobs: JobBatch, levels,
+                      max_nodes: int = 1
+                      ) -> tuple[Placements, ClusterState, TopoInfo]:
+    """Topology-restricted greedy solve.
+
+    ``levels`` is the leaf-first tuple of ``(group_of_node int32[N],
+    group_sizes int32[G])`` pairs (``Topology.jnp_levels``); -1 marks a
+    node outside every group at that level.  Admission (``decide_job``)
+    uses the GLOBAL feasible count, so a gang the cluster can hold is
+    never refused by the restriction — at worst it spans blocks and is
+    flagged ``cross``.
+    """
+    max_nodes = min(max_nodes, state.num_nodes)
+    leaf_gon, leaf_sizes = levels[0]
+    prepped = tuple((gon, sizes, _group_onehot(gon, sizes.shape[0]))
+                    for gon, sizes in levels)
+    leaf_onehot = prepped[0][2]
+
+    def step(carry, job):
+        avail, cost = carry
+        req, node_num, time_limit, part_mask, valid = job
+        eligible, feasible = job_feasibility(avail, state.alive, part_mask,
+                                             req)
+        ok, reason = decide_job(valid, node_num, max_nodes,
+                                jnp.sum(feasible, dtype=jnp.int32),
+                                jnp.sum(eligible, dtype=jnp.int32))
+
+        have_leaf, blk, leaf_mask = _level_fit(
+            feasible, leaf_onehot, leaf_gon, leaf_sizes, node_num)
+        gang = node_num > 1
+
+        def _span_branch():
+            # lowest fitting ancestor level bounds the spanning set; if
+            # no level fits, the whole cluster is the "ancestor"
+            anc_mask = jnp.ones_like(feasible)
+            for gon, sizes, onehot in reversed(prepped[1:]):
+                have, _, mask = _level_fit(feasible, onehot, gon, sizes,
+                                           node_num)
+                anc_mask = jnp.where(have, mask, anc_mask)
+            return _span_mask(feasible & anc_mask, leaf_onehot,
+                              leaf_gon, leaf_sizes, node_num)
+
+        def _local_branch():
+            return jnp.where(gang, leaf_mask, jnp.ones_like(feasible))
+
+        # the spanning fallback is the rare path; cond keeps its extra
+        # counts/argsort off the per-job critical path when a leaf fits
+        restrict = jax.lax.cond(gang & ~have_leaf, _span_branch,
+                                _local_branch)
+        masked_cost = jnp.where(feasible & restrict, cost, COST_INF)
+        sel_cost, idx = cheapest_k(masked_cost, max_nodes)
+        k_mask = jnp.arange(max_nodes) < node_num
+        sel = ok & k_mask & (sel_cost < COST_INF)
+        avail, cost = apply_placement(avail, cost, state.total, req,
+                                      time_limit, idx, sel)
+        chosen = jnp.where(sel, idx, -1)
+        in_block = ok & gang & have_leaf
+        cross = ok & gang & ~have_leaf
+        blk_out = jnp.where(in_block, blk, -1)
+        return (avail, cost), (ok, chosen, reason, in_block, cross,
+                               blk_out)
+
+    (avail, cost), (placed, nodes, reason, in_block, cross, block) = (
+        jax.lax.scan(
+            step, (state.avail, state.cost),
+            (jobs.req, jobs.node_num, jobs.time_limit, jobs.part_mask,
+             jobs.valid)))
+
+    new_state = state.replace(avail=avail, cost=cost)
+    return (Placements(placed=placed, nodes=nodes, reason=reason),
+            new_state,
+            TopoInfo(in_block=in_block, cross=cross, block=block))
+
+
+def solve_greedy_topo_permuted(state: ClusterState, jobs: JobBatch, topo,
+                               max_nodes: int = 1
+                               ) -> tuple[Placements, ClusterState,
+                                          TopoInfo]:
+    """Run the topo solve in block-major node order and map results back
+    to real node ids — the same permutation plumbing the scheduler
+    applies to the single-node backends, exercised against the direct
+    solve for equivalence testing.
+
+    Block ids are invariant under the node permutation and the stable
+    block-major sort preserves within-block id order, so with a
+    tie-free cost vector this returns exactly the direct solve's
+    placements.
+    """
+    perm = topo.jnp_perm
+    inv = topo.jnp_inv_perm
+    pstate = state.replace(avail=state.avail[perm],
+                           total=state.total[perm],
+                           alive=state.alive[perm],
+                           cost=state.cost[perm])
+    pjobs = jobs.replace(part_mask=jobs.part_mask[:, perm])
+    plevels = tuple((gon[perm], sizes) for gon, sizes in topo.jnp_levels)
+    placements, pstate2, info = solve_greedy_topo(
+        pstate, pjobs, plevels, max_nodes=max_nodes)
+    real_nodes = jnp.where(placements.nodes >= 0,
+                           perm[jnp.maximum(placements.nodes, 0)],
+                           jnp.int32(-1))
+    state2 = pstate2.replace(avail=pstate2.avail[inv],
+                             total=pstate2.total[inv],
+                             alive=pstate2.alive[inv],
+                             cost=pstate2.cost[inv])
+    return placements.replace(nodes=real_nodes), state2, info

@@ -106,6 +106,10 @@ class CraneConfig:
     # — MetricsPort absent/None = no /metrics endpoint, 0 = ephemeral
     observability: dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # interconnect topology (topo/): Topology: {Torus + Slice} shorthand
+    # or explicit {Blocks, Switches} tree — empty = no topology (gangs
+    # place with no locality restriction)
+    topology: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def metrics_port(self) -> int | None:
@@ -154,6 +158,11 @@ class CraneConfig:
                                        gres=node_cfg.gres,
                                        is_capacity=True),
                     partitions=tuple(node_cfg.partitions))
+        if self.topology:
+            from cranesched_tpu.topo.model import Topology
+            meta.set_topology(Topology.from_config(
+                self.topology, name_to_id=meta._name_to_id,
+                num_nodes=len(meta.nodes)))
 
         pr = self.priority
         weights = PriorityWeights(
@@ -316,4 +325,5 @@ def load_config(path: str) -> CraneConfig:
         node_event_hook_path=str(raw.get("NodeEventHook", "") or ""),
         tls=raw.get("Tls", {}) or {},
         license_sync=raw.get("LicenseSync", {}) or {},
-        observability=raw.get("Observability", {}) or {})
+        observability=raw.get("Observability", {}) or {},
+        topology=raw.get("Topology", {}) or {})

@@ -140,8 +140,15 @@ def test_pam_exec_helper_binary(plane, pam_helper):
     time.sleep(0.3)
 
     def helper(user, ptype="account"):
+        # run under an intermediate parent: on open_session the helper
+        # ADOPTs getppid() (production: the sshd that ran pam_exec)
+        # into the job cgroup, and job-end cleanup SIGKILLs every
+        # adopted pid — invoked bare, that would be pytest itself.
+        # The trailing `exit $?` defeats the shells' exec-last-command
+        # optimization so an actual intermediate process exists.
         return subprocess.run(
-            [pam_helper, d.pam_socket],
+            ["sh", "-c", '"$1" "$2"; exit $?', "sshd-standin",
+             pam_helper, d.pam_socket],
             env={"PAM_USER": user, "PAM_TYPE": ptype},
             timeout=10).returncode
 

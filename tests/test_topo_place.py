@@ -1,0 +1,500 @@
+"""Topology-aware gang placement: model, solve parity, scheduler e2e.
+
+Differential strategy as everywhere else in this repo: the device solve
+(topo/place.py) is checked bit-for-bit against an independent NumPy
+oracle (testing/topo_oracle.py) on randomized clusters — torus and
+explicit-tree topologies, drained nodes, partition masks, cross-block
+spanning fallback — plus the acceptance property from ISSUE 6: on a
+64-blocks-of-64 cluster every gang that CAN fit in one block DOES, and
+the scheduler e2e path (best-fit-block routing, block-major permutation
+seam, fragmentation gauge, cross-block counter) behaves end to end.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from cranesched_tpu.ctld import (  # noqa: E402
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.models.solver import (  # noqa: E402
+    JobBatch,
+    make_cluster_state,
+)
+from cranesched_tpu.obs.metrics import REGISTRY  # noqa: E402
+from cranesched_tpu.testing.topo_oracle import (  # noqa: E402
+    solve_greedy_topo_oracle,
+)
+from cranesched_tpu.topo import (  # noqa: E402
+    Topology,
+    solve_greedy_topo,
+    topology_doc,
+)
+from cranesched_tpu.topo.place import (  # noqa: E402
+    solve_greedy_topo_permuted,
+)
+
+pytestmark = pytest.mark.topo
+
+
+# ---------------------------------------------------------------- model
+
+def test_torus_model():
+    topo = Topology.from_torus([4, 4, 4], [2, 2, 2])
+    assert topo.num_nodes == 64 and topo.num_blocks == 8
+    assert topo.block_sizes.tolist() == [8] * 8
+    # node 0 = coord (0,0,0) -> block 0; node 63 = (3,3,3) -> block 7
+    assert topo.block_of_node[0] == 0 and topo.block_of_node[63] == 7
+    assert topo.coords[63].tolist() == [3, 3, 3]
+    assert topo.block_path(0) == ("slice-0x0x0",)
+    # perm is a block-major permutation: blocks appear in sorted runs,
+    # node ids inside a block keep their relative order (stable sort)
+    b = topo.block_of_node[topo.perm]
+    assert (np.diff(b) >= 0).all()
+    for blk in range(8):
+        ids = topo.perm[b == blk]
+        assert (np.diff(ids) > 0).all()
+    assert (topo.perm[topo.inv_perm] == np.arange(64)).all()
+    # block_masks partition the nodes
+    assert (topo.block_masks().sum(axis=0) == 1).all()
+
+
+def test_torus_validation():
+    with pytest.raises(ValueError):
+        Topology.from_torus([4, 4, 4], [3, 2, 2])  # 3 does not tile 4
+    with pytest.raises(ValueError):
+        Topology.from_torus([4, 4], [2, 2, 2])  # rank mismatch
+
+
+def test_explicit_tree_from_config():
+    name_to_id = {f"n{i}": i for i in range(8)}
+    topo = Topology.from_config({
+        "Blocks": [
+            {"name": "b0", "nodes": "n[0-1]"},
+            {"name": "b1", "nodes": "n[2-3]"},
+            {"name": "b2", "nodes": "n[4-5]"},
+        ],
+        "Switches": [{"name": "sw0", "blocks": ["b0", "b1"]}],
+    }, name_to_id=name_to_id, num_nodes=8)
+    assert topo.block_of_node.tolist() == [0, 0, 1, 1, 2, 2, -1, -1]
+    assert topo.block_path(0) == ("sw0", "b0")
+    assert topo.block_path(4) == ("b2",)   # b2 under no switch
+    assert topo.block_path(7) == ()        # ungrouped node
+    levels = topo.levels_np
+    assert [lv[0] for lv in levels] == ["block", "switch"]
+    # switch level: nodes 0-3 under sw0, others ungrouped
+    assert levels[1][1].tolist() == [0, 0, 0, 0, -1, -1, -1, -1]
+    assert levels[1][2].tolist() == [4]
+    # ungrouped nodes go LAST in the block-major permutation
+    assert set(topo.perm[-2:].tolist()) == {6, 7}
+
+    with pytest.raises(ValueError, match="unknown node"):
+        Topology.from_config({"Blocks": [{"name": "x", "nodes": "zz9"}]},
+                             name_to_id=name_to_id, num_nodes=8)
+    with pytest.raises(ValueError, match="two topology blocks"):
+        Topology.from_config({"Blocks": [
+            {"name": "a", "nodes": "n0"}, {"name": "b", "nodes": "n0"},
+        ]}, name_to_id=name_to_id, num_nodes=8)
+
+
+def test_fragmentation_and_doc():
+    topo = Topology.uniform_blocks(8, 2)
+    # all free nodes in one block -> 0.0; spread across 4 -> 0.75
+    free = np.zeros(8, bool)
+    free[0:2] = True
+    assert topo.fragmentation(free) == [("block", 0.0)]
+    assert topo.fragmentation(np.zeros(8, bool)) == [("block", 0.0)]
+    spread = np.array([1, 0, 1, 0, 1, 0, 1, 0], bool)
+    assert topo.fragmentation(spread) == [("block", 0.75)]
+    doc = topology_doc(topo, free_mask=spread)
+    assert doc["num_nodes"] == 8 and doc["num_blocks"] == 4
+    lv = doc["levels"][0]
+    assert lv["fragmentation"] == 0.75
+    assert [g["free"] for g in lv["groups"]] == [1, 1, 1, 1]
+
+
+# ------------------------------------------------------- oracle parity
+
+def random_topo_problem(rng, n_jobs, n_nodes, n_parts=1, max_nodes=8,
+                        drain_frac=0.1):
+    total = np.zeros((n_nodes, 3), np.int32)
+    total[:, 0] = rng.choice([16, 32, 64], n_nodes) * 256
+    total[:, 1] = rng.choice([64, 128], n_nodes) * 1024
+    total[:, 2] = total[:, 1]
+    used = rng.uniform(0, 0.5, n_nodes)
+    avail = (total * (1 - used[:, None])).astype(np.int32)
+    alive = rng.random(n_nodes) > drain_frac
+    cost = rng.uniform(0, 100, n_nodes).astype(np.float32)
+
+    req = np.zeros((n_jobs, 3), np.int32)
+    req[:, 0] = rng.choice([1, 2, 4], n_jobs) * 256
+    req[:, 1] = rng.choice([1, 4], n_jobs) * 1024
+    req[:, 2] = req[:, 1]
+    node_num = rng.integers(1, max_nodes + 1, n_jobs).astype(np.int32)
+    time_limit = rng.choice([60, 3600], n_jobs).astype(np.int32)
+    node_part = rng.integers(0, n_parts, n_nodes)
+    job_part = rng.integers(0, n_parts, n_jobs)
+    part_mask = node_part[None, :] == job_part[:, None]
+    valid = np.ones(n_jobs, bool)
+    return dict(avail=avail, total=total, alive=alive, cost=cost), dict(
+        req=req, node_num=node_num, time_limit=time_limit,
+        part_mask=part_mask, valid=valid)
+
+
+def run_both(state_d, jobs_d, topo, max_nodes):
+    state = make_cluster_state(state_d["avail"], state_d["total"],
+                               state_d["alive"], state_d["cost"])
+    jobs = JobBatch(
+        req=jnp.asarray(jobs_d["req"]),
+        node_num=jnp.asarray(jobs_d["node_num"]),
+        time_limit=jnp.asarray(jobs_d["time_limit"]),
+        part_mask=jnp.asarray(jobs_d["part_mask"]),
+        valid=jnp.asarray(jobs_d["valid"]))
+    placements, new_state, info = solve_greedy_topo(
+        state, jobs, topo.jnp_levels, max_nodes=max_nodes)
+    oracle = solve_greedy_topo_oracle(
+        state_d["avail"], state_d["total"], state_d["alive"],
+        state_d["cost"], jobs_d["req"], jobs_d["node_num"],
+        jobs_d["time_limit"], jobs_d["part_mask"], jobs_d["valid"],
+        max_nodes, [(gon, sizes) for _, gon, sizes, _ in topo.levels_np])
+    return placements, new_state, info, oracle
+
+
+def assert_parity(placements, new_state, info, oracle):
+    (o_placed, o_nodes, o_reason, o_avail, o_cost,
+     o_in, o_cross, o_block) = oracle
+    np.testing.assert_array_equal(np.asarray(placements.placed), o_placed)
+    np.testing.assert_array_equal(np.asarray(placements.nodes), o_nodes)
+    np.testing.assert_array_equal(np.asarray(placements.reason), o_reason)
+    np.testing.assert_array_equal(np.asarray(new_state.avail), o_avail)
+    np.testing.assert_array_equal(
+        np.asarray(new_state.cost, np.int64), o_cost)
+    np.testing.assert_array_equal(np.asarray(info.in_block), o_in)
+    np.testing.assert_array_equal(np.asarray(info.cross), o_cross)
+    np.testing.assert_array_equal(np.asarray(info.block), o_block)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_torus(seed):
+    rng = np.random.default_rng(100 + seed)
+    topo = Topology.from_torus([4, 4, 4], [2, 2, 2])
+    state_d, jobs_d = random_topo_problem(rng, n_jobs=48, n_nodes=64,
+                                          n_parts=2, max_nodes=8)
+    out = run_both(state_d, jobs_d, topo, max_nodes=8)
+    assert_parity(*out)
+    # the random mix must actually exercise both paths
+    info = out[2]
+    assert int(np.asarray(info.in_block).sum()) > 0
+
+
+def test_parity_explicit_tree_cross_block():
+    """Blocks of 4, a switch over two of them: gangs of 6 cannot fit in
+    any block, must span inside the switch via the LCA fallback."""
+    rng = np.random.default_rng(7)
+    n_nodes = 16
+    topo = Topology.from_config({
+        "Blocks": [
+            {"name": f"b{i}", "nodes": f"n[{4*i}-{4*i+3}]"}
+            for i in range(4)],
+        "Switches": [
+            {"name": "sw0", "blocks": ["b0", "b1"]},
+            {"name": "sw1", "blocks": ["b2", "b3"]}],
+    }, name_to_id={f"n{i}": i for i in range(n_nodes)},
+        num_nodes=n_nodes)
+    state_d, jobs_d = random_topo_problem(rng, n_jobs=12,
+                                          n_nodes=n_nodes,
+                                          max_nodes=8, drain_frac=0.0)
+    # uniform capacity so gangs of 6 are feasible but never block-local
+    state_d["total"][:] = state_d["total"][0]
+    state_d["avail"] = state_d["total"].copy()
+    jobs_d["node_num"][:] = 6
+    jobs_d["req"][:, 0] = 256
+    placements, new_state, info, oracle = run_both(state_d, jobs_d, topo,
+                                                   max_nodes=8)
+    assert_parity(placements, new_state, info, oracle)
+    crs = np.asarray(info.cross)
+    placed = np.asarray(placements.placed)
+    assert crs[placed].any() and not np.asarray(info.in_block).any()
+    # every cross gang stays inside ONE switch (LCA bound): its nodes'
+    # switch ids are all equal
+    sw_of_node = topo.levels_np[1][1]
+    for j in np.flatnonzero(placed):
+        picks = np.asarray(placements.nodes)[j]
+        sws = {int(sw_of_node[n]) for n in picks[picks >= 0]}
+        assert len(sws) == 1
+
+
+def test_acceptance_block_local_4096():
+    """ISSUE 6 acceptance: 4096 nodes in 64 blocks of 64 — every gang
+    with node_num <= 64 lands inside ONE block whenever any block has
+    room, oracle-verified; when no block fits, the spanning fallback
+    still places it and flags it cross."""
+    rng = np.random.default_rng(42)
+    n_nodes, block = 4096, 64
+    topo = Topology.uniform_blocks(n_nodes, block)
+    total = np.zeros((n_nodes, 3), np.int32)
+    total[:, 0] = 64 * 256
+    total[:, 1] = 128 * 1024
+    total[:, 2] = total[:, 1]
+    # pre-fragment: the first 24 nodes of EVERY block are busy, leaving
+    # exactly 40 free per block.  Demand below stays well under the
+    # total free pool, so an untouched block always exists and the
+    # "some block has capacity" premise of the acceptance property
+    # holds for every gang by construction.
+    avail = total.copy()
+    busy = (np.arange(n_nodes) % block) < 24
+    avail[busy] = 0
+    state_d = dict(avail=avail, total=total,
+                   alive=np.ones(n_nodes, bool),
+                   cost=rng.uniform(0, 10, n_nodes).astype(np.float32))
+    n_jobs = 32
+    jobs_d = dict(
+        req=np.tile(np.array([[256, 1024, 1024]], np.int32),
+                    (n_jobs, 1)),
+        node_num=rng.integers(2, 41, n_jobs).astype(np.int32),
+        time_limit=np.full(n_jobs, 3600, np.int32),
+        part_mask=np.ones((n_jobs, n_nodes), bool),
+        valid=np.ones(n_jobs, bool))
+    placements, new_state, info, oracle = run_both(
+        state_d, jobs_d, topo, max_nodes=block)
+    assert_parity(placements, new_state, info, oracle)
+    placed = np.asarray(placements.placed)
+    in_b = np.asarray(info.in_block)
+    nodes = np.asarray(placements.nodes)
+    assert placed.all()
+    # each gang must be block-local, with all its picks in ONE block
+    assert in_b[placed].all()
+    for j in range(n_jobs):
+        picks = nodes[j][nodes[j] >= 0]
+        assert len(picks) == jobs_d["node_num"][j]
+        blocks = set((picks // block).tolist())
+        assert blocks == {int(np.asarray(info.block)[j])}
+
+    # overload: drain all but 8 nodes per block — a gang of 16 cannot
+    # fit any block, must span and be flagged cross
+    avail2 = total.copy()
+    avail2[np.arange(n_nodes) % block >= 8] = 0
+    jobs2 = dict(jobs_d, node_num=np.full(n_jobs, 16, np.int32))
+    state2 = dict(state_d, avail=avail2)
+    p2, s2, info2, oracle2 = run_both(state2, jobs2, topo,
+                                      max_nodes=block)
+    assert_parity(p2, s2, info2, oracle2)
+    placed2 = np.asarray(p2.placed)
+    assert placed2.any()
+    assert np.asarray(info2.cross)[placed2].all()
+
+
+def test_permutation_equivalence():
+    """Interleaved block ids (perm is NOT identity): the permuted solve
+    — the scheduler's single-node seam plumbing — must return exactly
+    the direct solve's placements when costs are tie-free."""
+    rng = np.random.default_rng(3)
+    n_nodes = 24
+    block_of_node = (np.arange(n_nodes) % 3).astype(np.int32)
+    topo = Topology(n_nodes, block_of_node, ["b0", "b1", "b2"])
+    assert not (topo.perm == np.arange(n_nodes)).all()
+    state_d, jobs_d = random_topo_problem(rng, n_jobs=20,
+                                          n_nodes=n_nodes, max_nodes=4,
+                                          drain_frac=0.05)
+    state_d["cost"] = rng.permutation(n_nodes).astype(np.float32)  # ties-free
+    state = make_cluster_state(state_d["avail"], state_d["total"],
+                               state_d["alive"], state_d["cost"])
+    jobs = JobBatch(
+        req=jnp.asarray(jobs_d["req"]),
+        node_num=jnp.asarray(jobs_d["node_num"]),
+        time_limit=jnp.asarray(jobs_d["time_limit"]),
+        part_mask=jnp.asarray(jobs_d["part_mask"]),
+        valid=jnp.asarray(jobs_d["valid"]))
+    direct, dstate, dinfo = solve_greedy_topo(
+        state, jobs, topo.jnp_levels, max_nodes=4)
+    perm, pstate, pinfo = solve_greedy_topo_permuted(
+        state, jobs, topo, max_nodes=4)
+    np.testing.assert_array_equal(np.asarray(direct.placed),
+                                  np.asarray(perm.placed))
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(direct.nodes), axis=1),
+        np.sort(np.asarray(perm.nodes), axis=1))
+    np.testing.assert_array_equal(np.asarray(dstate.avail),
+                                  np.asarray(pstate.avail))
+    np.testing.assert_array_equal(np.asarray(dstate.cost),
+                                  np.asarray(pstate.cost))
+    np.testing.assert_array_equal(np.asarray(dinfo.in_block),
+                                  np.asarray(pinfo.in_block))
+    np.testing.assert_array_equal(np.asarray(dinfo.block),
+                                  np.asarray(pinfo.block))
+
+
+# ------------------------------------------------------ scheduler e2e
+
+def build_cluster(n_nodes, block, cpu=8.0, mem_gb=32, backfill=False,
+                  **cfg_kw):
+    meta = MetaContainer()
+    for i in range(n_nodes):
+        meta.add_node(f"n{i:02d}", meta.layout.encode(
+            cpu=cpu, mem_bytes=mem_gb << 30, is_capacity=True),
+            partitions=("default",))
+        meta.craned_up(i)
+    meta.set_topology(Topology.uniform_blocks(n_nodes, block))
+    sched = JobScheduler(meta, SchedulerConfig(
+        backfill=backfill, max_nodes_per_job=8, **cfg_kw))
+    return meta, sched
+
+
+@pytest.mark.parametrize("backfill", [False, True])
+def test_scheduler_topo_e2e(backfill):
+    meta, sched = build_cluster(16, 4, backfill=backfill)
+    topo = meta.topology
+    for _ in range(4):
+        sched.submit(JobSpec(res=ResourceSpec(cpu=2.0,
+                                              mem_bytes=1 << 30),
+                             node_num=3, time_limit=3600), now=0.0)
+    started = sched.schedule_cycle(now=1.0)
+    assert len(started) == 4
+    trace = sched.cycle_trace.snapshot()[-1]
+    assert trace["solver"] == "topo"
+    assert trace["topo_in_block"] == 4 and trace["topo_cross"] == 0
+    assert "topo_frag" in trace
+    assert sched.stats["topo_in_block_total"] == 4
+    for jid in range(1, 5):
+        job = sched.job_info(jid)
+        assert len(job.node_ids) == 3
+        blocks = {int(topo.block_of_node[n]) for n in job.node_ids}
+        assert len(blocks) == 1
+        assert job.topo_block in topo.block_names
+        assert not job.cross_block
+    # the fragmentation gauge made it to the exposition
+    assert "crane_topo_fragmentation" in REGISTRY.expose()
+
+
+def test_scheduler_cross_block_fallback():
+    """Blocks of 2, gang of 3: no block fits, the spanning fallback
+    places it, flags it, and bumps the counter."""
+    cross_counter = REGISTRY.counter("crane_topo_cross_block_gangs_total")
+    before = cross_counter.value()
+    meta, sched = build_cluster(6, 2)
+    sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                         node_num=3, time_limit=3600), now=0.0)
+    assert len(sched.schedule_cycle(now=1.0)) == 1
+    trace = sched.cycle_trace.snapshot()[-1]
+    assert trace["solver"] == "topo" and trace["topo_cross"] == 1
+    job = sched.job_info(1)
+    assert job.cross_block and job.topo_block == "spanning"
+    assert sched.stats["topo_cross_block_total"] == 1
+    assert cross_counter.value() == before + 1
+
+
+def test_scheduler_single_node_permutation_seam():
+    """Interleaved blocks (non-identity perm) + only single-node jobs:
+    the cycle takes the immediate path with the node axis permuted
+    block-major, and the committed node ids must be REAL ids — each of
+    the 8 full-node jobs lands on a distinct node."""
+    meta = MetaContainer()
+    n_nodes = 8
+    for i in range(n_nodes):
+        meta.add_node(f"n{i}", meta.layout.encode(
+            cpu=8.0, mem_bytes=32 << 30, is_capacity=True),
+            partitions=("default",))
+        meta.craned_up(i)
+    topo = Topology(n_nodes, (np.arange(n_nodes) % 2).astype(np.int32),
+                    ["even", "odd"])
+    assert not (topo.perm == np.arange(n_nodes)).all()
+    meta.set_topology(topo)
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+    for _ in range(n_nodes):
+        sched.submit(JobSpec(res=ResourceSpec(cpu=8.0,
+                                              mem_bytes=1 << 30),
+                             node_num=1, time_limit=3600), now=0.0)
+    assert len(sched.schedule_cycle(now=1.0)) == n_nodes
+    assert sched.cycle_trace.snapshot()[-1]["solver"] != "topo"
+    used = [sched.job_info(j).node_ids[0] for j in range(1, n_nodes + 1)]
+    assert sorted(used) == list(range(n_nodes))
+    # committed against the real registry: every node's cpu is drained
+    for node in meta.nodes.values():
+        assert node.avail[0] == 0
+
+
+def test_config_yaml_topology(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("""
+ClusterName: topo-test
+Nodes:
+  - name: tpu[0-7]
+    cpu: 8
+    memory: 32G
+Partitions:
+  - name: default
+Scheduler:
+  Backfill: false
+Topology:
+  Torus: [2, 2, 2]
+  Slice: [2, 2, 1]
+""")
+    from cranesched_tpu.utils.config import load_config
+    meta, sched = load_config(str(cfg)).build()
+    topo = meta.topology
+    assert topo is not None and topo.num_blocks == 2
+    assert meta.nodes[0].block_path == ("slice-0x0x0",)
+    assert meta.nodes[0].coords == (0, 0, 0)
+    assert meta.nodes[7].block_path == ("slice-0x0x1",)
+    for i in range(8):
+        meta.craned_up(i)
+    sched.submit(JobSpec(res=ResourceSpec(cpu=1.0, mem_bytes=1 << 30),
+                         node_num=4, time_limit=60), now=0.0)
+    assert len(sched.schedule_cycle(now=1.0)) == 1
+    assert sched.cycle_trace.snapshot()[-1]["solver"] == "topo"
+    job = sched.job_info(1)
+    assert {int(topo.block_of_node[n]) for n in job.node_ids} == {
+        int(topo.block_of_node[job.node_ids[0]])}
+
+
+def test_stale_topology_is_ignored():
+    """Nodes registered after the topology was built: size mismatch must
+    disable topo routing, not crash the cycle."""
+    meta, sched = build_cluster(8, 4)
+    meta.add_node("late", meta.layout.encode(
+        cpu=8.0, mem_bytes=32 << 30, is_capacity=True),
+        partitions=("default",))
+    meta.craned_up(8)
+    sched.submit(JobSpec(res=ResourceSpec(cpu=2.0, mem_bytes=1 << 30),
+                         node_num=2, time_limit=60), now=0.0)
+    assert len(sched.schedule_cycle(now=1.0)) == 1
+    assert sched.cycle_trace.snapshot()[-1]["solver"] != "topo"
+
+
+# -------------------------------------- meta cache regression (sat. 1)
+
+def test_update_node_total_invalidates_part_max_cache():
+    """A craned re-registering with different hardware must not leave
+    partition_max_total stale (it feeds submit-time feasibility)."""
+    meta = MetaContainer()
+    for i in range(2):
+        meta.add_node(f"n{i}", meta.layout.encode(
+            cpu=8.0, mem_bytes=32 << 30, is_capacity=True),
+            partitions=("p0",))
+    base = meta.partition_max_total("p0").copy()
+
+    # grow node 0: the cached max must follow
+    bigger = meta.layout.encode(cpu=32.0, mem_bytes=128 << 30,
+                                is_capacity=True)
+    assert meta.update_node_total(0, bigger)
+    after = meta.partition_max_total("p0")
+    assert (after == np.maximum(base, bigger)).all()
+    assert (after[0] > base[0])
+    # avail moved by the delta (no allocations -> full new capacity)
+    assert (meta.nodes[0].avail == bigger).all()
+
+    # shrink back below the other node: max falls back to node 1's total
+    smaller = meta.layout.encode(cpu=4.0, mem_bytes=16 << 30,
+                                 is_capacity=True)
+    assert meta.update_node_total(0, smaller)
+    assert (meta.partition_max_total("p0") == base).all()
+    assert (meta.nodes[0].avail == smaller).all()
+
+    # no-op update neither changes anything nor logs an event
+    assert not meta.update_node_total(0, smaller)
