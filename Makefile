@@ -23,8 +23,13 @@
 #              tests/test_topo_place.py): best-fit-block solve vs the
 #              numpy oracle, permutation equivalence, and the scheduler
 #              e2e on torus/explicit-tree topologies.
+# tier1-delta — incremental cycle-state lane (@pytest.mark.delta in
+#              tests/test_delta_cycle.py): PendingTable/delta-snapshot
+#              oracle parity vs the from-scratch rebuild, no-op
+#              fingerprint re-arm/skip guards, event-driven wakeups.
 
-.PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo
+.PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
+	tier1-delta
 
 tier1:
 	bash tools/tier1.sh
@@ -48,4 +53,8 @@ tier1-commit:
 
 tier1-topo:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m topo \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-delta:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m delta \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -207,6 +207,113 @@ def _measure_commit(num_jobs: int = 10_000,
     }
 
 
+def _build_churn_sched(num_jobs: int, num_nodes: int,
+                       incremental: bool):
+    """Small cluster + big queue for the churn scenario: after the
+    first cycle fills the nodes, the residual queue is steady-state
+    pending — exactly the shape where the incremental prelude should
+    scale with dirty rows, not queue depth."""
+    from cranesched_tpu.ctld import (
+        JobScheduler,
+        JobSpec,
+        MetaContainer,
+        ResourceSpec,
+        SchedulerConfig,
+    )
+
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(
+            f"c{i:05d}",
+            meta.layout.encode(cpu=64.0, mem_bytes=256 << 30,
+                               is_capacity=True),
+            partitions=("default",))
+        meta.craned_up(i)
+    # backfill off: future-start reservations would re-solve every
+    # cycle and keep the no-op fingerprint from ever arming — the
+    # scenario measures the immediate-fit steady state
+    sched = JobScheduler(meta, SchedulerConfig(
+        schedule_batch_size=num_jobs, backfill=False,
+        incremental=incremental))
+    rng = np.random.default_rng(42)
+
+    def spec():
+        return JobSpec(
+            res=ResourceSpec(cpu=float(rng.integers(1, 9)),
+                             mem_bytes=int(rng.integers(1, 17)) << 30),
+            node_num=1,
+            time_limit=int(rng.integers(3600, 86400)))
+
+    return sched, spec, rng
+
+
+def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
+                   churn: float = 0.01, cycles: int = 5) -> dict:
+    """The incremental-cycle acceptance scenario (ISSUE 8): a steady
+    queue with ``churn`` fraction cancelled+resubmitted per tick, run
+    twice — PendingTable path vs ``incremental=False`` full rebuild —
+    with identical seeds.  Reports the median prelude per cycle for
+    both, the dirty-row counts, and the cost of a fingerprint-hit idle
+    tick relative to a full cycle."""
+
+    def run(incremental: bool) -> dict:
+        sched, spec, rng = _build_churn_sched(num_jobs, num_nodes,
+                                              incremental)
+        for _ in range(num_jobs):
+            sched.submit(spec(), now=0.0)
+        started = len(sched.schedule_cycle(now=1.0))  # fills + compiles
+        sched.schedule_cycle(now=2.0)  # steady-state (zero-place) shape
+        k = max(int(len(sched.pending) * churn), 1)
+        preludes, totals, dirty = [], [], []
+        now = 3.0
+        for _ in range(cycles):
+            pend_ids = list(sched.pending.keys())
+            for i in rng.choice(len(pend_ids), size=k, replace=False):
+                sched.cancel(int(pend_ids[int(i)]), now=now)
+            for _ in range(k):
+                sched.submit(spec(), now=now)
+            sched.schedule_cycle(now=now + 0.5)
+            tr = sched.cycle_trace.snapshot()[-1]
+            preludes.append(float(tr.get("prelude_ms", 0.0)))
+            totals.append(float(tr.get("total_ms", 0.0)))
+            dirty.append(int(tr.get("dirty_jobs") or 0))
+            now += 1.0
+        # idle tick: the last cycle placed nothing, so the fingerprint
+        # is armed on the incremental path; the next no-event cycle
+        # should short-circuit before building anything
+        skipped0 = sched.stats.get("skipped_cycles", 0)
+        t0 = time.perf_counter()
+        sched.schedule_cycle(now=now)
+        idle_ms = (time.perf_counter() - t0) * 1e3
+        return {
+            "first_cycle_started": started,
+            "prelude_ms": round(float(np.median(preludes)), 3),
+            "total_ms": round(float(np.median(totals)), 3),
+            "dirty_rows": int(np.median(dirty)),
+            "idle_tick_ms": round(idle_ms, 3),
+            "skipped_cycles": (sched.stats.get("skipped_cycles", 0)
+                               - skipped0),
+        }
+
+    inc = run(True)
+    base = run(False)
+    full_ms = max(inc["total_ms"], 1e-9)
+    return {
+        "jobs": num_jobs, "nodes": num_nodes, "churn": churn,
+        "cycles": cycles,
+        "incremental": inc, "full_rebuild": base,
+        # same seed + same event stream: identical first-wave placement
+        # is the in-bench parity check (the real oracle lives in
+        # tests/test_delta_cycle.py)
+        "placements_match": bool(inc["first_cycle_started"]
+                                 == base["first_cycle_started"]),
+        "prelude_speedup": round(
+            base["prelude_ms"] / max(inc["prelude_ms"], 1e-9), 2),
+        "idle_tick_share": round(inc["idle_tick_ms"] / full_ms, 4),
+        "idle_skipped": bool(inc["skipped_cycles"] >= 1),
+    }
+
+
 def _build_gang_sched(num_jobs: int, num_nodes: int, block: int):
     """Gang-heavy cluster + scheduler for the topology scenario; the
     same seeded queue is replayed with and without a topology so the
@@ -308,6 +415,13 @@ def main() -> int:
         help="also run the topology scenario: gang-heavy queue with and "
              "without a generated block topology (intra-block placement "
              "rate + cycle-time delta; env BENCH_TOPOLOGY)")
+    ap.add_argument(
+        "--churn", action="store_true",
+        default=bool(os.environ.get("BENCH_CHURN")),
+        help="also run the incremental-cycle churn scenario: steady 1%% "
+             "queue churn, PendingTable vs full-rebuild prelude, plus "
+             "the fingerprint-hit idle-tick cost (env BENCH_CHURN; "
+             "shape via BENCH_CHURN_JOBS/BENCH_CHURN_NODES)")
     args = ap.parse_args()
 
     num_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
@@ -562,6 +676,18 @@ def main() -> int:
         except Exception as exc:
             topo_bench = {"error": f"{type(exc).__name__}: {exc}"}
 
+    churn_bench = None
+    if args.churn:
+        try:
+            churn_bench = _measure_churn(
+                num_jobs=int(os.environ.get("BENCH_CHURN_JOBS",
+                                            100_000)),
+                num_nodes=int(os.environ.get("BENCH_CHURN_NODES", 512)),
+                churn=float(os.environ.get("BENCH_CHURN_RATE", 0.01)),
+                cycles=int(os.environ.get("BENCH_CHURN_CYCLES", 5)))
+        except Exception as exc:
+            churn_bench = {"error": f"{type(exc).__name__}: {exc}"}
+
     print(json.dumps({
         "metric": "decisions_per_sec",
         "value": round(decisions_per_sec, 1),
@@ -578,6 +704,7 @@ def main() -> int:
             "sched_cycle": sched_cycle,
             "commit": commit_bench,
             "topology": topo_bench,
+            "churn": churn_bench,
             "device": str(dev), "repeats": repeats,
             "device_acquisition": acquisition,
         },

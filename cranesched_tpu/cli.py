@@ -669,7 +669,10 @@ def _cstats_stalled(doc) -> str | None:
     if wd.get("tick_mode") or not wd.get("last_cycle_walltime"):
         return None
     age = float(wd.get("now", 0.0)) - float(wd["last_cycle_walltime"])
-    limit = max(3.0 * float(wd.get("cycle_interval", 1.0)), 5.0)
+    # an event-driven leader may legitimately sleep up to idle_sleep
+    # between (skipped) cycles — don't call that a stall
+    limit = max(3.0 * float(wd.get("cycle_interval", 1.0)),
+                2.0 * float(wd.get("idle_sleep", 0.0)), 5.0)
     if age > limit:
         return (f"scheduler stalled: last completed cycle {age:.1f}s "
                 f"ago (cycle interval {wd.get('cycle_interval')}s)")
@@ -702,6 +705,12 @@ def cmd_cstats(args) -> int:
         rows = [(t.get("now"), t.get("solver"), t.get("queue_depth"),
                  t.get("candidates"), t.get("placed"),
                  t.get("backfilled"), t.get("preempted"),
+                 # SKIP: coalesced short-circuit count (+ reason);
+                 # DIRTY: jobs/nodes patched since the last cycle
+                 (f"{t.get('skips')}:{t.get('skip_reason')}"
+                  if t.get("skips") else "-"),
+                 (f"{t.get('dirty_jobs')}/{t.get('dirty_nodes')}"
+                  if t.get("dirty_jobs") is not None else "-"),
                  t.get("prelude_ms"), t.get("solve_ms"),
                  t.get("commit_ms"), t.get("dispatch_ms"),
                  t.get("lock_held_ms"), t.get("total_ms"),
@@ -709,8 +718,9 @@ def cmd_cstats(args) -> int:
                 for t in doc.get("cycle_trace", [])]
         print(_fmt_table(rows, (
             "NOW", "SOLVER", "QUEUE", "CAND", "PLACED", "BACKFILL",
-            "PREEMPT", "PRELUDE_MS", "SOLVE_MS", "COMMIT_MS",
-            "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC", "FRAG")))
+            "PREEMPT", "SKIP", "DIRTY", "PRELUDE_MS", "SOLVE_MS",
+            "COMMIT_MS", "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC",
+            "FRAG")))
         return 0
     if getattr(args, "metrics", False):
         rows = []

@@ -41,6 +41,10 @@ class License:
 class LicenseManager:
     def __init__(self):
         self.licenses: dict[str, License] = {}
+        # bumped whenever availability can have changed (configure /
+        # sync / malloc / free / restore that actually moved a count) —
+        # one term of the scheduler's no-op-cycle fingerprint
+        self.epoch = 0
 
     def configure(self, name: str, total: int,
                   remote: bool = False) -> None:
@@ -48,7 +52,10 @@ class LicenseManager:
         if lic is None:
             self.licenses[name] = License(name=name, total=total,
                                           remote=remote)
+            self.epoch += 1
         else:
+            if lic.total != total or lic.remote != remote:
+                self.epoch += 1
             lic.total = total
             lic.remote = remote
 
@@ -63,8 +70,12 @@ class LicenseManager:
             if lic is None:
                 lic = self.licenses[name] = License(
                     name=name, total=int(total), remote=True)
+                self.epoch += 1
             if not lic.remote:
                 continue   # a local license shadows the server's name
+            if (lic.total != int(total)
+                    or lic.external_used != max(int(used), 0)):
+                self.epoch += 1
             lic.total = int(total)
             lic.external_used = max(int(used), 0)
 
@@ -92,6 +103,8 @@ class LicenseManager:
             return False
         for name, count in (wanted or {}).items():
             self.licenses[name].in_use += count
+            if count:
+                self.epoch += 1
         return True
 
     def restore(self, wanted: Mapping[str, int] | None) -> None:
@@ -101,14 +114,16 @@ class LicenseManager:
         the overcommit drains, which is the safe direction."""
         for name, count in (wanted or {}).items():
             lic = self.licenses.get(name)
-            if lic is not None:
+            if lic is not None and count:
                 lic.in_use += count
+                self.epoch += 1
 
     def free(self, wanted: Mapping[str, int] | None) -> None:
         for name, count in (wanted or {}).items():
             lic = self.licenses.get(name)
-            if lic is not None:
+            if lic is not None and lic.in_use > 0 and count:
                 lic.in_use = max(lic.in_use - count, 0)
+                self.epoch += 1
 
 
 class LicenseSyncer:

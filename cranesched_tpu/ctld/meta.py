@@ -42,6 +42,15 @@ class Partition:
             account in self.allowed_accounts)
 
 
+# NodeMeta fields that feed the device snapshot (avail/total rows and
+# the schedulable flag): writes to these mark the node dirty so
+# MetaContainer.snapshot() can patch its cached arrays instead of
+# rebuilding.  last_ping/running_jobs are deliberately absent — a ping
+# must not bump the meta epoch and wake an idle scheduler.
+_SNAP_FIELDS = frozenset({"avail", "total", "alive", "drained",
+                          "health_drained", "power_state"})
+
+
 @dataclasses.dataclass
 class NodeMeta:
     """Reference CranedMeta (NodeDefs.h:59-81): static total + live avail,
@@ -78,6 +87,19 @@ class NodeMeta:
         return (self.alive and not self.drained
                 and not self.health_drained
                 and self.power_state != "POWEREDOFF")
+
+    def __setattr__(self, name, value):
+        # every mutation path in the tree (ledger, RPC handlers, HA
+        # follower, health checks) is a plain attribute assignment of a
+        # NEW value — never an in-place element write — so this hook is
+        # the single chokepoint that keeps the container's cached
+        # snapshot coherent.  During dataclass __init__ the owner
+        # backref does not exist yet, so construction is a no-op here.
+        object.__setattr__(self, name, value)
+        if name in _SNAP_FIELDS:
+            owner = self.__dict__.get("_owner")
+            if owner is not None:
+                owner._touch_node(self.node_id)
 
 
 @dataclasses.dataclass
@@ -136,6 +158,17 @@ class MetaContainer:
         self.reservations: dict[str, Reservation] = {}
         # bumped on any reservation change so mask caches invalidate
         self.resv_epoch = 0
+        # bumped on any snapshot-relevant node mutation (see
+        # _SNAP_FIELDS) — one term of the scheduler's no-op-cycle
+        # fingerprint.  ``_dirty_nodes`` are the rows snapshot() must
+        # patch in its cached arrays; ``delta_snapshot=False`` restores
+        # the full per-node rebuild (oracle baseline for the parity
+        # tests and bench --churn).
+        self.meta_epoch = 0
+        self._dirty_nodes: set[int] = set()
+        self._snap: tuple | None = None
+        self.delta_snapshot = True
+        self.last_snapshot_dirty = 0
         # interconnect topology (topo.model.Topology), attached via
         # set_topology() once the node registry is complete
         self.topology = None
@@ -162,6 +195,9 @@ class MetaContainer:
                         partitions=set(partitions))
         self.nodes[node_id] = node
         self._name_to_id[name] = node_id
+        node._owner = self        # arm the dirty-row hook (NodeMeta)
+        self.meta_epoch += 1
+        self._snap = None         # shape changed: next snapshot rebuilds
         for p in node.partitions:
             if p not in self.partitions:
                 self.add_partition(p)
@@ -381,21 +417,51 @@ class MetaContainer:
 
     # ---- device snapshot ----
 
+    def _touch_node(self, node_id: int) -> None:
+        """NodeMeta.__setattr__ hook: a snapshot-relevant field moved."""
+        self.meta_epoch += 1
+        if self._snap is not None:
+            self._dirty_nodes.add(node_id)
+
     def snapshot(self):
         """Dense SoA arrays for the device solve, aligned by node_id.
 
         Returns (avail[N,R], total[N,R], alive[N]) as NumPy; the scheduler
         owns moving them to device and building per-job masks.
+
+        Delta-based: the arrays are cached and only the rows dirtied
+        since the last call are re-read from the ledger (O(dirty), not
+        O(nodes)).  Callers must treat the result as read-only — the
+        same arrays are returned every cycle (``jnp.asarray`` copies to
+        device, and host-side consumers never write).
         """
         n = len(self.nodes)
-        r = self.layout.num_dims
-        avail = np.zeros((n, r), np.int32)
-        total = np.zeros((n, r), np.int32)
-        alive = np.zeros(n, bool)
-        for i, node in self.nodes.items():
-            avail[i] = node.avail
-            total[i] = node.total
-            alive[i] = node.schedulable
+        if (not self.delta_snapshot or self._snap is None
+                or len(self._snap[2]) != n):
+            r = self.layout.num_dims
+            avail = np.zeros((n, r), np.int32)
+            total = np.zeros((n, r), np.int32)
+            alive = np.zeros(n, bool)
+            for i, node in self.nodes.items():
+                avail[i] = node.avail
+                total[i] = node.total
+                alive[i] = node.schedulable
+            self.last_snapshot_dirty = n
+            if self.delta_snapshot:
+                self._snap = (avail, total, alive)
+                self._dirty_nodes.clear()
+            return avail, total, alive
+        avail, total, alive = self._snap
+        dirty = self._dirty_nodes
+        self.last_snapshot_dirty = len(dirty)
+        if dirty:
+            nodes = self.nodes
+            for i in dirty:
+                node = nodes[i]
+                avail[i] = node.avail
+                total[i] = node.total
+                alive[i] = node.schedulable
+            dirty.clear()
         return avail, total, alive
 
     def partition_mask(self, partition: str, include: Iterable[str] = (),

@@ -42,6 +42,15 @@ from cranesched_tpu.ctld.defs import (
 from cranesched_tpu.ctld.accounting import AccountMetaContainer
 from cranesched_tpu.ctld.licenses import LicenseManager
 from cranesched_tpu.ctld.meta import MetaContainer
+from cranesched_tpu.ctld.pending_table import (
+    GATE_BEGIN,
+    GATE_CANDIDATE,
+    GATE_DEP,
+    GATE_DEP_NEVER,
+    GATE_HELD,
+    GATE_LICENSE,
+    PendingTable,
+)
 from cranesched_tpu.ctld.runledger import RunLedger
 from cranesched_tpu.models.priority import (
     PendingPriorityAttrs,
@@ -94,7 +103,14 @@ _MET_STARTED = _OBS.counter(
 _MET_PREEMPTED = _OBS.counter(
     "crane_preempted_total", "running jobs evicted by preemption")
 _MET_PENDING = _OBS.gauge(
-    "crane_pending_jobs", "pending queue depth at cycle start")
+    "crane_pending_jobs",
+    "pending queue depth (updated on submit/finish events)")
+_MET_RUNNING = _OBS.gauge(
+    "crane_running_jobs",
+    "running job count (updated on start/finish events)")
+_MET_SKIPS = _OBS.counter(
+    "crane_cycle_skips_total",
+    "cycles short-circuited by the no-op fingerprint (label reason)")
 _MET_TOPO_FRAG = _OBS.gauge(
     "crane_topo_fragmentation",
     "free-capacity fragmentation per topology level "
@@ -106,6 +122,16 @@ _MET_TOPO_CROSS = _OBS.counter(
 _REASON_MAP = {
     REASON_RESOURCE: PendingReason.RESOURCE,
     REASON_CONSTRAINT: PendingReason.CONSTRAINT,
+}
+
+# PendingTable gate code -> the pending reason the old Python candidate
+# loop would have written for the same blocked job
+_GATE_REASON = {
+    GATE_HELD: PendingReason.HELD,
+    GATE_BEGIN: PendingReason.BEGIN_TIME,
+    GATE_DEP: PendingReason.DEPENDENCY,
+    GATE_DEP_NEVER: PendingReason.DEPENDENCY_NEVER_SATISFIED,
+    GATE_LICENSE: PendingReason.LICENSE,
 }
 
 
@@ -171,6 +197,17 @@ class SchedulerConfig:
     # max(8, nodes // 64), capped at 128 — a 10k-node cluster gets 128
     # concurrent pushes instead of the historical hardcoded 8.
     dispatch_workers: int | None = None
+    # incremental cycle state (YAML ``Incremental``): the PendingTable
+    # candidate pass, delta meta snapshots, and the no-op-cycle
+    # fingerprint short-circuit.  False restores the from-scratch
+    # rebuild every cycle — the parity oracle and bench baseline.
+    incremental: bool = True
+    # event-driven loop (YAML ``CycleIdleSleep``): the longest the
+    # server's cycle loop may sleep when the no-op fingerprint is armed
+    # and no event arrives.  Bounds staleness of anything outside the
+    # event/edge model (e.g. remote license syncs, which deliberately
+    # do not kick the loop).
+    cycle_idle_sleep: float = 30.0
 
     def __post_init__(self):
         if self.preempt_mode not in ("off", "requeue", "cancel"):
@@ -200,6 +237,54 @@ class StatusChange:
     incarnation: int | None = None
 
 
+class _ObservedDict(dict):
+    """dict with membership hooks: every insert/removal notifies the
+    scheduler so derived indexes (the PendingTable, the template and
+    alloc_only sets, the queue-depth gauges, the event-loop kick) stay
+    in sync at the MUTATION SITE instead of being rebuilt per cycle.
+    Hooks fire after the dict mutation, with the key's final value."""
+
+    def __init__(self, on_set, on_del):
+        super().__init__()
+        self._on_set = on_set
+        self._on_del = on_del
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self._on_set(key, value)
+
+    def __delitem__(self, key):
+        value = super().pop(key)
+        self._on_del(key, value)
+
+    def pop(self, key, *default):
+        if key in self:
+            value = super().pop(key)
+            self._on_del(key, value)
+            return value
+        if default:
+            return default[0]
+        raise KeyError(key)
+
+    def popitem(self):
+        key, value = super().popitem()
+        self._on_del(key, value)
+        return key, value
+
+    def clear(self):
+        while self:
+            self.popitem()
+
+    def update(self, *args, **kwargs):
+        for key, value in dict(*args, **kwargs).items():
+            self[key] = value
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self[key] = default
+        return super().__getitem__(key)
+
+
 class _MaskTable:
     """Device-resident ``[C, N]`` eligibility-row table — the factored
     form of the per-job ``part_mask``.
@@ -224,6 +309,10 @@ class _MaskTable:
     def __init__(self):
         self.epoch = -1
         self.num_nodes = -1
+        # monotonic reset counter: PendingTable rows cache their class
+        # id stamped with this, so a reset invalidates every cached id
+        # without touching the rows
+        self.generation = 0
         self.key_to_class: dict[tuple, int] = {}
         self._bytes_to_class: dict[bytes, int] = {}
         self.rows: list[np.ndarray] = []
@@ -237,6 +326,7 @@ class _MaskTable:
     def reset(self, epoch: int, num_nodes: int) -> None:
         self.epoch = epoch
         self.num_nodes = num_nodes
+        self.generation += 1
         self.key_to_class.clear()
         self._bytes_to_class.clear()
         row0 = np.zeros(max(num_nodes, 1), bool)
@@ -347,8 +437,43 @@ class JobScheduler:
         # LuaJobHandler.h:39: rewrite the spec or reject with a message):
         # JobSpec -> JobSpec (possibly modified) | None (reject)
         self.submit_hook = submit_hook
-        self.pending: dict[int, Job] = {}    # job_id -> Job, insertion = id order
-        self.running: dict[int, Job] = {}
+        # persistent SoA mirror of the pending queue (ctld/
+        # pending_table.py): event hooks below keep it current, the
+        # cycle masks it vectorially instead of walking Job objects
+        self._ptable = PendingTable(meta.layout.num_dims)
+        # membership indexes maintained by the dict hooks so per-cycle
+        # scans iterate exactly the rows they need, never O(pending) /
+        # O(running): array templates awaiting materialization, and
+        # alloc_only jobs whose time limit ctld itself enforces
+        self._array_templates: set[int] = set()
+        self._alloc_only: set[int] = set()
+        # event-driven loop plumbing: the server points cycle_kick at
+        # its wakeup event; mutations that can change the next cycle's
+        # outcome call _kick() so a sleeping loop wakes immediately
+        self.cycle_kick: Callable[[], None] | None = None
+        # no-op short-circuit state: fingerprint + nearest time edge,
+        # armed after a zero-placement cycle (_arm_noop / _cycle_body)
+        self._noop_fp: tuple | None = None
+        self._noop_edge: float = float("inf")
+        self._cycle_fp0: tuple | None = None
+        self._skip_trace: dict | None = None
+        # PendingTable row indexes aligned with the in-flight cycle's
+        # candidates/ordered lists (the vectorized row-build gathers)
+        self._cand_rows: np.ndarray | None = None
+        self._ordered_rows: np.ndarray | None = None
+        # running-set priority attrs: rebuilt only when running-set
+        # MEMBERSHIP changes (the dict hooks bump _run_epoch on
+        # start/finish/requeue) — per cycle only run_time is recomputed
+        # from the cached start times
+        self._run_attrs: tuple | None = None
+        self._run_epoch = 0
+        meta.delta_snapshot = self.config.incremental
+        # job_id -> Job; insertion = id order (the hooks mirror
+        # membership into the table/indexes/gauges at mutation time)
+        self.pending: dict[int, Job] = _ObservedDict(
+            self._on_pending_set, self._on_pending_del)
+        self.running: dict[int, Job] = _ObservedDict(
+            self._on_running_set, self._on_running_del)
         self.history: dict[int, Job] = {}    # terminal jobs
         self._status_queue: collections.deque[StatusChange] = (
             collections.deque())
@@ -402,7 +527,7 @@ class JobScheduler:
         # observability (reference per-phase wall-clock trace,
         # JobScheduler.cpp:1444-1447,1723-1903)
         self.stats = {
-            "cycles": 0, "jobs_started_total": 0,
+            "cycles": 0, "skipped_cycles": 0, "jobs_started_total": 0,
             "jobs_submitted_total": 0, "jobs_finished_total": 0,
             "last_cycle": {}, "last_cycle_walltime": 0.0,
         }
@@ -465,6 +590,207 @@ class JobScheduler:
         self.archive = archive
         self._next_job_id = max(getattr(self, "_next_job_id", 1),
                                 archive.max_job_id() + 1)
+
+    # ------------------------------------------------------------------
+    # incremental cycle state (ARCHITECTURE.md "Incremental cycle
+    # state"): membership hooks, the PendingTable row derivation, the
+    # no-op-cycle fingerprint, and the event-driven loop's sleep seam
+    # ------------------------------------------------------------------
+
+    def _kick(self) -> None:
+        """Wake the server's event-driven cycle loop (no-op standalone)."""
+        kick = self.cycle_kick
+        if kick is not None:
+            kick()
+
+    def _on_pending_set(self, job_id: int, job: Job) -> None:
+        self._table_upsert(job)
+        if job.spec.array is not None:
+            self._array_templates.add(job_id)
+        _MET_PENDING.set(len(self.pending))
+        self._kick()
+
+    def _on_pending_del(self, job_id: int, job: Job) -> None:
+        self._ptable.remove(job_id)
+        self._array_templates.discard(job_id)
+        _MET_PENDING.set(len(self.pending))
+        self._kick()
+
+    def _on_running_set(self, job_id: int, job: Job) -> None:
+        if job.spec.alloc_only:
+            self._alloc_only.add(job_id)
+        self._run_epoch += 1
+        _MET_RUNNING.set(len(self.running))
+
+    def _on_running_del(self, job_id: int, job: Job) -> None:
+        self._alloc_only.discard(job_id)
+        self._run_epoch += 1
+        _MET_RUNNING.set(len(self.running))
+
+    def _dep_cols(self, job: Job) -> tuple[float, bool]:
+        """``(dep_ready_time, never)`` table columns mirroring
+        ``_deps_runnable`` exactly: the row is dep-blocked while
+        ``dep_ready_time > now``; ``never`` selects the
+        DEPENDENCY_NEVER_SATISFIED reason.  Edges still waiting on an
+        event map to +inf with never=False — only ``_trigger_dep_event``
+        (which refreshes the row) can unblock them."""
+        if not job.dep_state:
+            return float("-inf"), False
+        states = list(job.dep_state.values())
+        if job.spec.deps_is_or:
+            finite = [v for v in states
+                      if v is not None and v != DEP_NEVER]
+            if finite:
+                return min(finite), False
+            if all(v == DEP_NEVER for v in states):
+                return float("inf"), True
+            return float("inf"), False
+        if any(v == DEP_NEVER for v in states):
+            return float("inf"), True
+        if any(v is None for v in states):
+            return float("inf"), False
+        return max(states), False
+
+    def _table_upsert(self, job: Job) -> None:
+        """Derive one PendingTable row from the Job (the table owns
+        storage; the scheduler owns JobSpec semantics).  Every value the
+        cycle's vectorized passes gather must be re-derived here on any
+        event that can change it."""
+        spec = job.spec
+        dep, dep_never = self._dep_cols(job)
+        req, node_num, time_limit = self._job_row(job)
+        part = self.meta.partitions.get(spec.partition)
+        packed = bool(spec.exclusive or spec.task_res is not None
+                      or (spec.ntasks is not None
+                          and spec.ntasks != spec.node_num)
+                      or spec.ntasks_per_node_max > 1)
+        self._ptable.upsert(
+            job.job_id,
+            template=spec.array is not None,
+            held=job.held,
+            begin=(spec.begin_time if spec.begin_time is not None
+                   else float("-inf")),
+            dep=dep, dep_never=dep_never,
+            lic=self._ptable.lic_key(spec.licenses),
+            submit=job.submit_time,
+            qos=job.qos_priority,
+            part=part.priority if part is not None else 0,
+            nnum=node_num,
+            cpus=float(req[DIM_CPU]) / 256.0 * spec.node_num,
+            mem=float(req[DIM_MEM]) * spec.node_num,
+            acct=self._account_id(spec.account),
+            tlimit=time_limit,
+            packed=packed,
+            req=req)
+
+    def _table_refresh(self, job: Job) -> None:
+        """Re-derive a pending job's row after an IN-PLACE mutation
+        (hold / modify / dep trigger — paths that don't re-insert into
+        the dict) and wake the loop."""
+        if job.job_id in self.pending:
+            self._table_upsert(job)
+            self._kick()
+
+    def _cycle_fingerprint(self) -> tuple:
+        """Everything a zero-placement solve's outcome depends on, as
+        epochs: queue content (table), node availability/liveness
+        (meta), license seats, reservation set.  Time-dependent gates
+        (begin/dep/reservation windows) are handled by ``_noop_edge``,
+        not the fingerprint."""
+        return (self._ptable.epoch, self.meta.meta_epoch,
+                self.licenses.epoch, self.meta.resv_epoch)
+
+    def _arm_noop(self, now: float) -> None:
+        """Arm the no-op short-circuit after a cycle that placed
+        nothing, preempted nothing, and queued no dispatch: until an
+        epoch moves or the nearest time edge passes, an identical cycle
+        would place nothing again (every candidate failed against the
+        same snapshot, and aging alone cannot create a placement when
+        zero jobs placed — order among non-placing jobs is moot).
+        Never armed with preemption enabled: a preemptor's eligibility
+        depends on running-set age, which no epoch tracks."""
+        if not self.config.incremental:
+            return
+        if self.config.preempt_mode != "off" and self.accounts is not None:
+            return
+        fp = self._cycle_fp0
+        if fp is None or self._cycle_fingerprint() != fp:
+            return   # something moved mid-cycle; next cycle must look
+        edge = self._ptable.next_edge(now)
+        for resv in self.meta.reservations.values():
+            # activity flips don't bump resv_epoch — cover them by edge
+            if resv.start_time > now:
+                edge = min(edge, resv.start_time)
+            if resv.end_time > now:
+                edge = min(edge, resv.end_time)
+        self._noop_fp = fp
+        self._noop_edge = edge
+
+    def _skip_cycle(self, t0, now: float, reason: str) -> list[int]:
+        """The short-circuited cycle: count it, refresh watchdog
+        liveness, and coalesce consecutive skips into ONE trace-ring
+        row (an idle night must not flush real cycles out of the
+        ring).  The queue drains already ran — only the snapshot /
+        sort / solve / commit machinery is skipped."""
+        import time as _time
+        self.stats["cycles"] += 1
+        _MET_CYCLES.inc()
+        self.stats["skipped_cycles"] = (
+            self.stats.get("skipped_cycles", 0) + 1)
+        _MET_SKIPS.inc(reason=reason)
+        ms = round((_time.perf_counter() - t0) * 1e3, 3)
+        self.stats["last_cycle_walltime"] = _time.time()
+        self.stats["last_cycle"] = {
+            "solver": "skip", "prelude_ms": ms, "total_ms": ms,
+            "pending": 0, "started": 0, "running": len(self.running)}
+        st = self._skip_trace
+        if st is not None:
+            st["skips"] = st.get("skips", 0) + 1
+            st["now"] = now
+            st["total_ms"] = ms
+        else:
+            trace = {
+                "now": now, "queue_depth": len(self.pending),
+                "solver": "skip", "skip_reason": reason, "skips": 1,
+                "prelude_ms": ms, "solve_ms": 0.0, "commit_ms": 0.0,
+                "dispatch_ms": 0.0, "total_ms": ms, "lock_held_ms": ms,
+                "candidates": 0, "placed": 0, "preempted": 0,
+                "backfilled": 0, "running": len(self.running)}
+            self.cycle_trace.push(trace)
+            self._skip_trace = trace
+        return []
+
+    def can_idle(self) -> bool:
+        """True when the event-driven loop may sleep up to
+        ``cycle_idle_sleep``: the no-op fingerprint is armed and still
+        matches, and no queued work (dispatch ring, status/step
+        reports, unconfirmed kill / time-limit intents) needs the next
+        cycle.  Call under the server lock."""
+        return (self.config.incremental
+                and self._noop_fp is not None
+                and self._cycle_fingerprint() == self._noop_fp
+                and not self._dispatch_ring
+                and not self._status_queue
+                and not self._step_report_queue
+                and not self._cancel_kill_sent
+                and not self._step_cancel_sent
+                and not self._limit_intents)
+
+    def next_wake_time(self, now: float) -> float:
+        """Earliest future moment a sleeping loop must cycle even
+        without an event: a begin/dep/reservation edge (_noop_edge),
+        an alloc_only job's time limit (ctld enforces those itself),
+        or the next craned ping-timeout sweep.  +inf when nothing is
+        time-gated."""
+        wake = self._noop_edge
+        for job_id in self._alloc_only:
+            job = self.running.get(job_id)
+            if job is not None and job.status == JobStatus.RUNNING:
+                wake = min(wake, self._effective_end(job, now))
+        if any(node.alive and node.expect_pings
+               for node in self.meta.nodes.values()):
+            wake = min(wake, now + self.config.craned_timeout / 2)
+        return wake
 
     # ------------------------------------------------------------------
     # submit / cancel / hold (reference SubmitJobToScheduler :3405,
@@ -626,12 +952,18 @@ class JobScheduler:
             if dep_job is None:
                 done.add(jid)
                 continue
+            changed = False
             for dep in dep_job.spec.dependencies:
                 if dep.job_id != target.job_id:
                     continue
                 sat = self._dep_satisfied_time(dep, target)
                 if sat is not None:
                     dep_job.dep_state[dep.job_id] = sat
+                    changed = True
+            if changed:
+                # dep_state mutated in place: the table row must see
+                # the new dep-ready time / NEVER verdict
+                self._table_refresh(dep_job)
             if all(v is not None
                    for v in dep_job.dep_state.values()):
                 done.add(jid)
@@ -695,6 +1027,7 @@ class JobScheduler:
                 self.wal.job_updated(job)
             self._cancel_kill_sent[job_id] = now
             self.dispatch_terminate(job_id, now)
+            self._kick()   # kill-intent renewal runs on the cycle thread
             return True
         return False
 
@@ -720,6 +1053,7 @@ class JobScheduler:
                               else PendingReason.NONE)
         if self.wal is not None:
             self.wal.job_updated(job)
+        self._table_refresh(job)
         return True
 
     def requeue(self, job_id: int, now: float) -> str:
@@ -839,6 +1173,7 @@ class JobScheduler:
                 self._limit_intents[job_id] = (float(time_limit), now)
                 self.dispatch_change_time_limit(job_id, float(time_limit),
                                                 now)
+                self._kick()   # intent re-sends run on the cycle thread
         if priority is not None:
             job.qos_priority = int(priority)
         if partition is not None:
@@ -846,6 +1181,8 @@ class JobScheduler:
             job.pending_reason = PendingReason.NONE
         if self.wal is not None:
             self.wal.job_updated(job)
+        if not running:
+            self._table_refresh(job)
         return ""
 
     def dispatch_change_time_limit(self, job_id: int, time_limit: float,
@@ -923,6 +1260,7 @@ class JobScheduler:
         self._status_queue.append(
             StatusChange(job_id, status, exit_code, now,
                          incarnation=queue_incarnation))
+        self._kick()   # Event.set is thread-safe (transport threads)
 
     def step_report_async(self, job_id: int, step_id: int,
                           status: "StepStatus", exit_code: int,
@@ -932,6 +1270,7 @@ class JobScheduler:
         (drained at the next process_status_changes)."""
         self._step_report_queue.append(
             (job_id, step_id, status, exit_code, now, incarnation))
+        self._kick()
 
     def process_status_changes(self) -> int:
         """Drain the queue (cycle step 1).  Returns #processed.
@@ -1022,6 +1361,7 @@ class JobScheduler:
         self._ledger.remove(job.job_id)
         self.licenses.free(job.spec.licenses or {})
         self._free_run_limits(job)
+        self._kick()   # freed capacity: pending jobs may now place
 
     def _ledger_add(self, job: Job, now: float) -> None:
         """Register a just-started (or re-adopted) job's allocation rows
@@ -1339,6 +1679,7 @@ class JobScheduler:
         self._step_cancel_sent[(job_id, step_id)] = now
         if self.wal is not None:
             self.wal.job_updated(job)
+        self._kick()   # kill-intent renewal runs on the cycle thread
         return True
 
     def _teardown_alloc_job(self, job: Job, now: float,
@@ -1426,10 +1767,13 @@ class JobScheduler:
         if self.wal is not None:
             self.wal.job_updated(job)
         if step_id == 0 and not job.spec.alloc_only:
-            # the batch step IS the job: feed the job-level machine
+            # the batch step IS the job: feed the job-level machine —
+            # and wake the loop: the close runs on the cycle thread,
+            # which may be deep in an idle sleep
             self._status_queue.append(StatusChange(
                 job_id, JobStatus(status.value), exit_code, now,
                 incarnation=job.requeue_count))
+            self._kick()
             return
         self._try_start_steps(job, now)
 
@@ -1451,9 +1795,11 @@ class JobScheduler:
     def _check_alloc_timeouts(self, now: float) -> None:
         """alloc_only jobs have no batch supervisor enforcing the time
         limit — the ctld cycle enforces it (reference: ctld-side
-        termination timers for allocations)."""
-        for job_id, job in list(self.running.items()):
-            if not job.spec.alloc_only:
+        termination timers for allocations).  Iterates the _alloc_only
+        index, not the running map (the scan is per-cycle)."""
+        for job_id in sorted(self._alloc_only):
+            job = self.running.get(job_id)
+            if job is None or not job.spec.alloc_only:
                 continue
             if job.status != JobStatus.RUNNING:
                 continue
@@ -1729,6 +2075,19 @@ class JobScheduler:
         self._materialize_array_children(now)
         t_prelude = _time.perf_counter()
 
+        # no-op short-circuit: the drains above already ran (they are
+        # the event sinks), so if no epoch moved since the last armed
+        # zero-placement solve and no time edge passed, this cycle
+        # would rebuild the identical inputs and place nothing — skip
+        # before building anything
+        fp = self._cycle_fingerprint()
+        if (self.config.incremental and self._noop_fp is not None
+                and fp == self._noop_fp and now < self._noop_edge
+                and not self._dispatch_ring):
+            return self._skip_cycle(t0, now, "fingerprint")
+        self._cycle_fp0 = fp
+        self._noop_fp = None
+
         self.stats["cycles"] += 1
         _MET_CYCLES.inc()
         candidates = self._pending_candidates(now)
@@ -1742,12 +2101,16 @@ class JobScheduler:
                 "prelude_ms": round((t_prelude - t0) * 1e3, 3),
                 "pending": 0, "started": 0,
                 "running": len(self.running)}
+            self._skip_trace = None
+            self._arm_noop(now)
             return []
         limit = self.config.schedule_batch_size
         if len(candidates) > limit:
             for job in candidates[limit:]:
                 job.pending_reason = PendingReason.PRIORITY
             candidates = candidates[:limit]
+            if self._cand_rows is not None:
+                self._cand_rows = self._cand_rows[:limit]
 
         # snapshot + event capture window (cpp:1437)
         self.meta.start_logging()
@@ -1769,10 +2132,15 @@ class JobScheduler:
         # cycles containing packed/exclusive jobs route to the
         # full-fidelity packed solver (immediate-fit; such jobs don't get
         # backfill reservations this round)
-        packed = any(j.spec.exclusive or j.spec.task_res is not None
-                     or (j.spec.ntasks is not None
-                         and j.spec.ntasks != j.spec.node_num)
-                     or j.spec.ntasks_per_node_max > 1 for j in ordered)
+        orows = self._ordered_rows
+        if orows is not None and len(orows) == len(ordered):
+            packed = bool(self._ptable.packed[orows].any())
+        else:
+            packed = any(j.spec.exclusive or j.spec.task_res is not None
+                         or (j.spec.ntasks is not None
+                             and j.spec.ntasks != j.spec.node_num)
+                         or j.spec.ntasks_per_node_max > 1
+                         for j in ordered)
         if packed:
             state = make_cluster_state(avail, total, alive, cost0)
             pbatch = self._packed_batch(jobs_batch.dense, ordered)
@@ -1794,7 +2162,10 @@ class JobScheduler:
         topo = self._active_topology()
         if topo is not None:
             self._update_topo_fragmentation(topo, avail, total, alive)
-        if topo is not None and any(j.spec.node_num > 1 for j in ordered):
+        if topo is not None and (
+                bool((self._ptable.nnum[orows] > 1).any())
+                if orows is not None and len(orows) == len(ordered)
+                else any(j.spec.node_num > 1 for j in ordered)):
             # gang cycle with a topology configured: route through the
             # best-fit-block solve (topo/place.py).  Backfill is skipped
             # for this cycle — locality dominates reservation lookahead
@@ -2134,12 +2505,21 @@ class JobScheduler:
             wal_groups=wal_groups,
             candidates=len(candidates),
             placed=len(started),
+            dirty_jobs=self._ptable.last_dirty,
+            dirty_nodes=self.meta.last_snapshot_dirty,
         )
         self.cycle_trace.push(trace)
+        self._skip_trace = None
         _MET_PHASE.observe(prelude_ms / 1e3, phase="prelude")
         _MET_PHASE.observe(solve_ms / 1e3, phase="solve")
         _MET_PHASE.observe(commit_ms / 1e3, phase="commit")
         _MET_LOCK.observe((prelude_ms + commit_ms) / 1e3)
+        # a zero-placement solve with nothing preempted or in flight can
+        # arm the no-op fingerprint: the next cycle seeing the same
+        # epochs would rebuild identical inputs and place nothing
+        if (not started and trace.get("preempted", 0) == 0
+                and not self._dispatch_ring):
+            self._arm_noop(trace.get("now", 0.0))
 
     def _solve_native(self, avail, total, alive, cost0, jobs_batch,
                       max_nodes):
@@ -2367,7 +2747,12 @@ class JobScheduler:
     # ------------------------------------------------------------------
 
     def _materialize_array_children(self, now: float) -> None:
-        for parent in list(self.pending.values()):
+        # the _array_templates index replaces an O(pending) scan; id
+        # order == the old dict-iteration order (ids are monotonic)
+        for parent_id in sorted(self._array_templates):
+            parent = self.pending.get(parent_id)
+            if parent is None:
+                continue
             if parent.spec.array is None or not parent.array_remaining:
                 continue
             if parent.held:
@@ -2712,6 +3097,30 @@ class JobScheduler:
                 self.on_craned_down(node.node_id, now)
 
     def _pending_candidates(self, now: float) -> list[Job]:
+        """Candidate scan: one vectorized pass over the PendingTable
+        (incremental mode) or the legacy per-job Python walk.  Both
+        produce the identical candidate list and pending_reason writes
+        (oracle: tests/test_delta_cycle.py)."""
+        if not self.config.incremental:
+            self._cand_rows = None
+            return self._pending_candidates_rebuild(now)
+        pt = self._ptable
+        lic_ok = pt.license_mask(self.licenses.sufficient)
+        cand_rows, changed, gates = pt.candidates(now, lic_ok)
+        pending = self.pending
+        jid = pt.job_id
+        for row, gate in zip(changed.tolist(), gates.tolist()):
+            job = pending.get(int(jid[row]))
+            if job is None or gate == GATE_CANDIDATE:
+                # candidates never get a reason write here — the old
+                # loop left stale reasons on runnable jobs too, and the
+                # solve/batch-cut paths overwrite them downstream
+                continue
+            job.pending_reason = _GATE_REASON[gate]
+        self._cand_rows = cand_rows
+        return [pending[int(j)] for j in jid[cand_rows]]
+
+    def _pending_candidates_rebuild(self, now: float) -> list[Job]:
         """Skip held / future-begin-time jobs (cpp:1374-1413); dependency
         gating joins here once dependencies land."""
         out = []
@@ -2747,15 +3156,18 @@ class JobScheduler:
     def _priority_sort(self, candidates: list[Job], now: float
                        ) -> list[Job]:
         if self.config.priority_type == "basic" or not candidates:
+            self._ordered_rows = self._cand_rows
             return candidates  # FIFO: id order (JobScheduler.h:183-201)
 
-        for job in candidates:
-            self._account_id(job.spec.account)
-        for job in self.running.values():
-            self._account_id(job.spec.account)
-        # bucketed: num_accounts is a jit static arg, and the dense index
-        # grows monotonically — pad so new accounts rarely recompile
-        num_accounts = self._bucket(len(self._account_index))
+        # vectorized path: gather priority attrs straight from the
+        # PendingTable columns (O(1) numpy gathers) instead of touching
+        # every Job object; priority output is invariant to the account
+        # index permutation so upsert-time registration is parity-safe
+        prows = self._cand_rows
+        vec = prows is not None and len(prows) == len(candidates)
+        if not vec:
+            for job in candidates:
+                self._account_id(job.spec.account)
 
         def job_row(job: Job):
             req = self._job_row(job)[0]   # spec-cached encode
@@ -2766,48 +3178,90 @@ class JobScheduler:
                     job.spec.node_num, total_cpu, total_mem,
                     self._account_id(job.spec.account))
 
-        # pad both batches to bucketed shapes (same rationale as
-        # _build_batch: keep the jit cache small)
-        JP = self._bucket(len(candidates))
-        p_rows = [job_row(j) for j in candidates]
-
         def col(rows, k, dt, size):
             arr = np.zeros(size, dt)
             arr[: len(rows)] = [r[k] for r in rows]
             return jnp.asarray(arr)
 
-        age = np.zeros(JP, np.int32)
-        age[: len(candidates)] = [max(now - j.submit_time, 0.0)
-                                  for j in candidates]
+        # running-set attrs: none of them change while a job RUNS (qos,
+        # partition, shape and account are modify-refused for running
+        # jobs; only run_time ages), so the padded device arrays are
+        # cached until the running-set epoch moves — membership churn
+        # rebuilds them, and job_row re-registers every running account
+        # then, which is why this block precedes num_accounts
+        ra = self._run_attrs
+        if ra is None or ra[0] != self._run_epoch:
+            r_jobs = list(self.running.values())
+            nR = len(r_jobs)
+            RP = self._bucket(nR) if r_jobs else 16
+            r_rows = [job_row(j) for j in r_jobs]
+            start = np.full(RP, np.inf)
+            start[:nR] = [j.start_time if j.start_time is not None
+                          else np.inf for j in r_jobs]
+            r_valid = np.zeros(RP, bool)
+            r_valid[:nR] = True
+            ra = (self._run_epoch, nR, RP, start,
+                  tuple(col(r_rows, k, dt, RP) for k, dt in (
+                      (0, np.int32), (1, np.int32), (2, np.int32),
+                      (3, np.float32), (4, np.float32), (5, np.int32))),
+                  jnp.asarray(r_valid))
+            self._run_attrs = ra
+        # bucketed: num_accounts is a jit static arg, and the dense index
+        # grows monotonically — pad so new accounts rarely recompile
+        num_accounts = self._bucket(len(self._account_index))
+
+        # pad both batches to bucketed shapes (same rationale as
+        # _build_batch: keep the jit cache small)
+        JP = self._bucket(len(candidates))
+
         p_valid = np.zeros(JP, bool)
         p_valid[: len(candidates)] = True
-        pending = PendingPriorityAttrs(
-            age=jnp.asarray(age),
-            qos_prio=col(p_rows, 0, np.int32, JP),
-            part_prio=col(p_rows, 1, np.int32, JP),
-            node_num=col(p_rows, 2, np.int32, JP),
-            cpus=col(p_rows, 3, np.float32, JP),
-            mem=col(p_rows, 4, np.float32, JP),
-            account=col(p_rows, 5, np.int32, JP),
-            valid=jnp.asarray(p_valid))
+        if vec:
+            pt = self._ptable
+            kN = len(candidates)
 
-        r_jobs = list(self.running.values())
-        RP = self._bucket(len(r_jobs)) if r_jobs else 16
-        r_rows = [job_row(j) for j in r_jobs]
+            def pcol(src, dt):
+                arr = np.zeros(JP, dt)
+                arr[:kN] = src[prows]
+                return jnp.asarray(arr)
+
+            age = np.zeros(JP, np.int32)
+            age[:kN] = np.maximum(now - pt.submit[prows], 0.0)
+            pending = PendingPriorityAttrs(
+                age=jnp.asarray(age),
+                qos_prio=pcol(pt.qos, np.int32),
+                part_prio=pcol(pt.part, np.int32),
+                node_num=pcol(pt.nnum, np.int32),
+                cpus=pcol(pt.cpus, np.float32),
+                mem=pcol(pt.mem, np.float32),
+                account=pcol(pt.acct, np.int32),
+                valid=jnp.asarray(p_valid))
+        else:
+            p_rows = [job_row(j) for j in candidates]
+            age = np.zeros(JP, np.int32)
+            age[: len(candidates)] = [max(now - j.submit_time, 0.0)
+                                      for j in candidates]
+            pending = PendingPriorityAttrs(
+                age=jnp.asarray(age),
+                qos_prio=col(p_rows, 0, np.int32, JP),
+                part_prio=col(p_rows, 1, np.int32, JP),
+                node_num=col(p_rows, 2, np.int32, JP),
+                cpus=col(p_rows, 3, np.float32, JP),
+                mem=col(p_rows, 4, np.float32, JP),
+                account=col(p_rows, 5, np.int32, JP),
+                valid=jnp.asarray(p_valid))
+
+        _, nR, RP, r_start, r_cols, r_valid = ra
         run_time = np.zeros(RP, np.int32)
-        run_time[: len(r_jobs)] = [max(now - (j.start_time or now), 0.0)
-                                   for j in r_jobs]
-        r_valid = np.zeros(RP, bool)
-        r_valid[: len(r_jobs)] = True
+        if nR:
+            # start == +inf encodes "not started yet" → clamps to 0,
+            # matching the old per-job `now - (start or now)`
+            run_time[:nR] = np.maximum(now - r_start[:nR], 0.0)
         running = RunningPriorityAttrs(
-            qos_prio=col(r_rows, 0, np.int32, RP),
-            part_prio=col(r_rows, 1, np.int32, RP),
-            node_num=col(r_rows, 2, np.int32, RP),
-            cpus=col(r_rows, 3, np.float32, RP),
-            mem=col(r_rows, 4, np.float32, RP),
-            account=col(r_rows, 5, np.int32, RP),
+            qos_prio=r_cols[0], part_prio=r_cols[1], node_num=r_cols[2],
+            cpus=r_cols[3], mem=r_cols[4], account=r_cols[5],
             run_time=jnp.asarray(run_time),
-            valid=jnp.asarray(r_valid))
+            valid=r_valid)
 
         pri = np.asarray(multifactor_priority(
             pending, running, self.config.priority_weights, num_accounts))
@@ -2815,6 +3269,7 @@ class JobScheduler:
         order = order[order < len(candidates)]  # drop -inf padding rows
         for job, p in zip(candidates, pri):
             job.priority = float(p)
+        self._ordered_rows = prows[order] if vec else None
         return [candidates[i] for i in order]
 
     @staticmethod
@@ -2932,10 +3387,32 @@ class JobScheduler:
         job_class = np.zeros(J, np.int32)
         valid = np.zeros(J, bool)
         self._refresh_mask_table()
-        for i, job in enumerate(ordered):
-            req[i], node_num[i], time_limit[i] = self._job_row(job)
-            job_class[i] = self._class_for(job, now)
-            valid[i] = True
+        orows = self._ordered_rows
+        if orows is not None and len(orows) == len(ordered):
+            pt = self._ptable
+            kN = len(ordered)
+            req[:kN] = pt.req[orows]
+            node_num[:kN] = pt.nnum[orows]
+            time_limit[:kN] = pt.tlimit[orows]
+            valid[:kN] = True
+            if self.meta.reservations:
+                # reservation-scoped class keys depend on now — can't
+                # cache per mask-table generation
+                for i, job in enumerate(ordered):
+                    job_class[i] = self._class_for(job, now)
+            else:
+                gen = self._mask_table.generation
+                stale = np.nonzero(pt.cls_gen[orows] != gen)[0]
+                for i in stale.tolist():
+                    r = int(orows[i])
+                    pt.cls[r] = self._class_for(ordered[i], now)
+                    pt.cls_gen[r] = gen
+                job_class[:kN] = pt.cls[orows]
+        else:
+            for i, job in enumerate(ordered):
+                req[i], node_num[i], time_limit[i] = self._job_row(job)
+                job_class[i] = self._class_for(job, now)
+                valid[i] = True
         max_nodes = max(1, min(int(node_num.max(initial=1)),
                                self.config.max_nodes_per_job))
         # bucket the static gang bound too (it is a jit static arg)
@@ -3172,6 +3649,10 @@ class JobScheduler:
                 if sat is None:
                     self._dependents.setdefault(dep.job_id, set()).add(
                         job.job_id)
+        # the table rows written as jobs were inserted above predate the
+        # dep re-derivation; re-upsert so dep columns match dep_state
+        for job in self.pending.values():
+            self._table_upsert(job)
 
     def rebuild_device_state(self) -> None:
         """Promotion-time rebuild of device-resident scheduler state.
@@ -3192,6 +3673,17 @@ class JobScheduler:
             for job in col.values():
                 job.row_cache = None
                 job.alloc_cache = None
+        # caches are cleared FIRST so _table_upsert re-encodes rows
+        # against the fresh layout; the incremental caches themselves
+        # restart cold (the old leader's epochs mean nothing here)
+        self._ptable = PendingTable(self.meta.layout.num_dims)
+        for job in self.pending.values():
+            self._table_upsert(job)
+        self.meta._snap = None
+        self._noop_fp = None
+        self._cand_rows = None
+        self._ordered_rows = None
+        self._run_attrs = None
 
     def job_info(self, job_id: int) -> Job | None:
         return (self.pending.get(job_id) or self.running.get(job_id)

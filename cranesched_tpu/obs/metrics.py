@@ -16,7 +16,11 @@ solve|commit), ``crane_rpc_latency_seconds`` (histogram, label method),
 ``crane_topo_fragmentation`` (gauge, label level — per-topology-level
 free-capacity fragmentation) and
 ``crane_topo_cross_block_gangs_total`` (counter — gangs placed by the
-cross-block spanning fallback).  ``*_total`` are monotonic counters;
+cross-block spanning fallback), ``crane_cycle_skips_total`` (counter,
+label reason — cycles short-circuited by the no-op fingerprint) and
+``crane_pending_jobs``/``crane_running_jobs`` (gauges, updated on the
+submit/start/finish EVENTS so they stay honest between the
+event-driven loop's idle sleeps).  ``*_total`` are monotonic counters;
 ``*_seconds`` histograms use the shared log-scale buckets below
 (100 µs .. ~100 s), which cover both RPC latencies and multi-second
 TPU solves without per-metric tuning.

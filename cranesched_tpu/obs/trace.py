@@ -23,6 +23,15 @@ Cycle-trace schema (ARCHITECTURE.md "Observability"):
     preempted        int     victims killed by this cycle
     backfilled       int     placed with start_bucket > 0 (future start)
     queue_depth      int     pending queue size at cycle start
+    dirty_jobs       int     PendingTable rows dirtied since last cycle
+    dirty_nodes      int     node rows patched into the cached snapshot
+                             (0 on a cache hit; == all nodes on rebuild)
+    skip_reason      str     only on solver="skip" rows: why the cycle
+                             short-circuited ("fingerprint")
+    skips            int     only on solver="skip" rows: consecutive
+                             skipped cycles coalesced into this row
+                             (idle clusters would otherwise flush the
+                             ring with identical no-op entries)
 
 ``solve_span`` wraps a solve closure in ``jax.profiler.TraceAnnotation``
 so tools/kexp.py traces line up with cycle phases; it degrades to a

@@ -69,6 +69,7 @@ def _drain(sched, sim, max_cycles=100_000):
         virtual_drain_s=end,
         wall_s=round(wall, 3),
         cycles=sched.stats["cycles"],
+        skipped_cycles=sched.stats.get("skipped_cycles", 0),
         jobs_per_wall_s=round(total / wall, 1) if wall else 0.0,
     )
 

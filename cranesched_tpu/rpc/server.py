@@ -87,6 +87,13 @@ class CtldServer:
         self._server: grpc.Server | None = None
         self._cycle_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # event-driven cycle wakeup (the reference's
+        # m_task_scheduler_thread_ condition variable): submits, status
+        # changes, and node/reservation events set this so the loop
+        # never sleeps through work, and an idle cluster can sleep past
+        # the base tick (SchedulerConfig.cycle_idle_sleep)
+        self._cycle_kick = threading.Event()
+        scheduler.cycle_kick = self._cycle_kick.set
         # HA: a standby serves the read surface from its shadow state
         # and aborts mutations with FAILED_PRECONDITION so failover-
         # aware clients (HaCtldClient, craned's address rotation) move
@@ -471,6 +478,8 @@ class CtldServer:
                 allowed_accounts=(list(request.allowed_accounts)
                                   if request.allowed_accounts else None),
                 denied_accounts=list(request.denied_accounts))
+        if resv is not None:
+            self._cycle_kick.set()
         return pb.OkReply(ok=resv is not None,
                           error="" if resv else "conflict")
 
@@ -480,6 +489,8 @@ class CtldServer:
             return pb.OkReply(ok=False, error=deny)
         with self._lock:
             ok = self.scheduler.meta.delete_reservation(request.name)
+        if ok:
+            self._cycle_kick.set()
         return pb.OkReply(ok=ok, error="" if ok else "no such reservation")
 
     def ModifyNode(self, request, context):
@@ -526,6 +537,7 @@ class CtldServer:
             else:
                 return pb.OkReply(ok=False,
                                   error=f"unknown action {action!r}")
+            self._cycle_kick.set()
             return pb.OkReply(ok=True)
 
     def QueryStats(self, request, context):
@@ -556,6 +568,8 @@ class CtldServer:
             doc["watchdog"] = {
                 "now": time.time(),
                 "cycle_interval": self.cycle_interval,
+                "idle_sleep": float(getattr(
+                    self.scheduler.config, "cycle_idle_sleep", 0.0)),
                 "tick_mode": self.tick_mode,
                 "last_cycle_walltime":
                     self.scheduler.stats.get("last_cycle_walltime", 0.0),
@@ -686,6 +700,7 @@ class CtldServer:
                     "drain" if node.health_drained else "undrain",
                     node.name, f"health: {request.message}",
                     now=self._now())
+                self._cycle_kick.set()
             return pb.OkReply(ok=True)
 
     def IssueToken(self, request, context):
@@ -791,6 +806,7 @@ class CtldServer:
                         if node.node_id in job.node_ids]
             # the craned latches this epoch and fences lower-epoch
             # pushes — the deposed leader's in-flight RPCs die here
+            self._cycle_kick.set()
             return pb.CranedRegisterReply(
                 ok=True, node_id=node.node_id, expected_jobs=expected,
                 fencing_epoch=self.scheduler.fencing_epoch)
@@ -1067,7 +1083,15 @@ class CtldServer:
         crane_cycle_crashes_total is bumped, the half-run generator is
         closed, and the NEXT tick schedules normally (fault-injection
         test: tests/test_obs.py)."""
-        while not self._stop.wait(self.cycle_interval):
+        while not self._stop.is_set():
+            # condition-variable tick: any event ends the sleep early;
+            # with no events the timeout is the base cadence, or the
+            # idle bound when the scheduler proves the next cycle would
+            # be a no-op anyway (_sleep_interval)
+            self._cycle_kick.wait(self._sleep_interval())
+            self._cycle_kick.clear()
+            if self._stop.is_set():
+                break
             if self.ha_role != "leader":
                 continue  # standby: shadow state only, never schedule
             now = time.time()
@@ -1075,6 +1099,26 @@ class CtldServer:
                 self._cycle_once(now)
             except Exception:
                 self._record_cycle_crash(now)
+
+    def _sleep_interval(self) -> float:
+        """Upper bound for the loop's event wait.  The base cadence
+        unless the scheduler can prove the next tick would short-circuit
+        (armed no-op fingerprint, nothing in flight) — then sleep up to
+        ``cycle_idle_sleep``, clipped to the nearest time-dependent edge
+        (begin_time/dep deadline, reservation boundary, alloc-only
+        expiry, ping-timeout check).  Events still wake us instantly."""
+        base = self.cycle_interval
+        sched = self.scheduler
+        idle = float(getattr(sched.config, "cycle_idle_sleep", 0.0))
+        if self.ha_role != "leader" or idle <= base:
+            return base
+        with self._lock:
+            if not sched.can_idle():
+                return base
+            wake = sched.next_wake_time(time.time())
+        if wake == float("inf"):
+            return idle
+        return min(idle, max(wake - time.time(), base))
 
     def _cycle_once(self, now: float) -> None:
         """One lock-break cycle: state phases under the lock, solve
@@ -1124,6 +1168,7 @@ class CtldServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._cycle_kick.set()  # wake a possibly long idle sleep
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
             self._metrics_server = None

@@ -195,7 +195,12 @@ class CraneConfig:
             # post-commit push fan-out width; None lets the dispatcher
             # derive it from cluster size (max(8, nodes // 64), cap 128)
             dispatch_workers=(int(sc["DispatchWorkers"])
-                              if sc.get("DispatchWorkers") else None))
+                              if sc.get("DispatchWorkers") else None),
+            # incremental cycle state (PendingTable + delta snapshot +
+            # no-op fingerprint); off = from-scratch rebuild every tick
+            incremental=bool(sc.get("Incremental", True)),
+            # provably-idle loop sleep bound (event kicks end it early)
+            cycle_idle_sleep=float(sc.get("CycleIdleSleep", 30)))
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)

@@ -1,0 +1,316 @@
+"""Incremental cycle state (ISSUE 8, `make tier1-delta`).
+
+The PendingTable + delta snapshot + no-op fingerprint must be invisible
+to scheduling semantics: over a randomized event script the incremental
+path (``SchedulerConfig.incremental=True``, the default) must produce
+bit-exact placements, pending reasons, and ledger state against the
+from-scratch rebuild (``incremental=False`` — the old per-tick Python
+walk, kept verbatim as ``_pending_candidates_rebuild``).
+
+Plus the short-circuit guards: gated jobs re-arm the fingerprint when
+their state flips (hold release, begin_time edge, dependency, license
+seats), a skipped cycle still refreshes watchdog liveness, and the skip
+never fires while a dispatch ring exists or preemption is configured.
+"""
+
+import numpy as np
+import pytest
+
+from cranesched_tpu.craned.sim import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.defs import Dependency, DepType
+
+pytestmark = pytest.mark.delta
+
+
+def _cluster(incremental: bool, num_nodes: int = 4, **cfg):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"n{i:02d}", meta.layout.encode(
+            cpu=8, mem_bytes=16 << 30, memsw_bytes=16 << 30,
+            is_capacity=True))
+        meta.craned_up(i)
+    cfg.setdefault("backfill", False)
+    sched = JobScheduler(meta, SchedulerConfig(incremental=incremental,
+                                               **cfg))
+    sched.licenses.configure("lic", total=2)
+    sim = SimCluster(sched)
+    sim.wire(sched)
+    return sched, sim
+
+
+def spec(**kw):
+    kw.setdefault("res", ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                                      memsw_bytes=1 << 30))
+    return JobSpec(**kw)
+
+
+def _state(sched):
+    """Everything scheduling semantics can observe, for the oracle."""
+    avail, total, alive = sched.meta.snapshot()
+    # job.priority is deliberately NOT compared: on a skipped cycle the
+    # incremental path leaves the display value stale (the rebuild path
+    # re-ages it every tick) — cosmetic, never placement-affecting
+    return {
+        "pending": {jid: (str(job.pending_reason), job.held)
+                    for jid, job in sched.pending.items()},
+        "running": sorted(sched.running),
+        "history": sorted(sched.history),
+        "avail": np.asarray(avail).copy(),
+        "alive": np.asarray(alive).copy(),
+        "licenses": {n: (lic.in_use, lic.total) for n, lic in
+                     sched.licenses.licenses.items()},
+    }
+
+
+def _random_spec(rng, now):
+    kw = {}
+    if rng.random() < 0.15:
+        kw["held"] = True
+    if rng.random() < 0.15:
+        kw["begin_time"] = float(now + rng.integers(1, 8))
+    if rng.random() < 0.25:
+        kw["licenses"] = {"lic": 1}
+    return spec(
+        res=ResourceSpec(cpu=float(rng.integers(1, 5)),
+                         mem_bytes=int(rng.integers(1, 5)) << 30,
+                         memsw_bytes=int(rng.integers(1, 5)) << 30),
+        node_num=int(rng.integers(1, 3)),
+        time_limit=float(rng.integers(60, 3600)),
+        sim_runtime=float(rng.integers(1, 6)), **kw)
+
+
+def test_oracle_parity_randomized():
+    """The acceptance oracle: identical event script against both paths
+    — submits (held/begin_time/licensed), holds, modifies, cancels,
+    license churn, node drains and deaths — cycle by cycle."""
+    inc = _cluster(True)
+    ref = _cluster(False)
+    rng_script = np.random.default_rng(7)
+
+    def both(fn):
+        fn(*inc)
+        fn(*ref)
+
+    for t in range(1, 41):
+        now = float(t)
+        ops = rng_script  # one shared stream: both sides see the same
+        for _ in range(int(ops.integers(0, 4))):
+            s = _random_spec(np.random.default_rng(
+                int(ops.integers(0, 2**31))), now)
+            both(lambda sched, sim, s=s: sched.submit(s, now=now))
+        pend = sorted(inc[0].pending)
+        if pend and ops.random() < 0.4:
+            jid = int(pend[int(ops.integers(0, len(pend)))])
+            # capture the flip target NOW: the first side's hold() call
+            # mutates the flag the lambda would otherwise re-read
+            flip = not inc[0].pending[jid].held
+            r = ops.random()
+            if r < 0.3:
+                both(lambda sched, sim: sched.hold(
+                    jid, held=flip, now=now))
+            elif r < 0.5:
+                both(lambda sched, sim: sched.cancel(jid, now=now))
+            else:
+                tl = float(ops.integers(60, 7200))
+                both(lambda sched, sim: sched.modify_job(
+                    jid, now=now, time_limit=tl))
+        if ops.random() < 0.2:
+            k = int(ops.integers(0, 4))
+            both(lambda sched, sim: sched.licenses.configure(
+                "lic", total=k))
+        if ops.random() < 0.15:
+            node = int(ops.integers(0, 4))
+            flag = bool(ops.integers(0, 2))
+            both(lambda sched, sim: sched.meta.drain(node, flag))
+        if ops.random() < 0.08:
+            node = int(ops.integers(0, 4))
+            both(lambda sched, sim: sched.on_craned_down(node, now))
+        elif ops.random() < 0.15:
+            node = int(ops.integers(0, 4))
+            both(lambda sched, sim: sched.meta.craned_up(node))
+
+        started = []
+        for sched, sim in (inc, ref):
+            sim.advance_to(now)
+            started.append(sched.schedule_cycle(now=now))
+        assert started[0] == started[1], f"t={t}: placements diverged"
+        si, sr = _state(inc[0]), _state(ref[0])
+        for key in si:
+            if isinstance(si[key], np.ndarray):
+                assert np.array_equal(si[key], sr[key]), f"t={t} {key}"
+            else:
+                assert si[key] == sr[key], f"t={t} {key}"
+    # the incremental side must actually have exercised the fast path
+    assert inc[0].stats["cycles"] > 0
+    assert len(inc[0]._ptable) == len(inc[0].pending)
+
+
+def test_held_flip_rearms_fingerprint():
+    sched, sim = _cluster(True)
+    jid = sched.submit(spec(held=True, sim_runtime=1.0), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == []   # gated, arms
+    assert sched.schedule_cycle(now=2.0) == []   # fingerprint hit
+    assert sched.stats["skipped_cycles"] == 1
+    assert sched.stats["last_cycle"]["solver"] == "skip"
+    sched.hold(jid, held=False, now=3.0)         # epoch bump re-arms
+    assert sched.schedule_cycle(now=3.0) == [jid]
+    assert sched.stats["skipped_cycles"] == 1
+
+
+def test_begin_time_edge_defeats_skip():
+    sched, sim = _cluster(True)
+    jid = sched.submit(spec(begin_time=10.0, sim_runtime=1.0), now=0.0)
+    assert sched.schedule_cycle(now=1.0) == []
+    assert sched.schedule_cycle(now=2.0) == []   # skipped: edge at 10
+    assert sched.stats["skipped_cycles"] == 1
+    # crossing the begin_time edge must run a REAL cycle with no event
+    assert sched.schedule_cycle(now=11.0) == [jid]
+
+
+def test_dependency_flip_rearms():
+    sched, sim = _cluster(True)
+    a = sched.submit(spec(sim_runtime=2.0), now=0.0)
+    b = sched.submit(spec(
+        sim_runtime=1.0,
+        dependencies=(Dependency(job_id=a, type=DepType.AFTER_OK),)),
+        now=0.0)
+    assert sched.schedule_cycle(now=1.0) == [a]
+    assert sched.schedule_cycle(now=2.0) == []   # b dep-gated, arms
+    assert sched.schedule_cycle(now=2.5) == []
+    assert sched.stats["skipped_cycles"] == 1
+    sim.advance_to(4.0)                          # a completes
+    assert sched.schedule_cycle(now=4.0) == [b]
+
+
+def test_license_flip_rearms():
+    sched, sim = _cluster(True)
+    sched.licenses.configure("ext", total=2, remote=True)
+    sched.licenses.sync({"ext": (2, 2)})   # server: all seats taken
+    jid = sched.submit(spec(licenses={"ext": 1}, sim_runtime=1.0),
+                       now=0.0)
+    assert sched.schedule_cycle(now=1.0) == []
+    assert sched.schedule_cycle(now=2.0) == []
+    assert sched.stats["skipped_cycles"] == 1
+    sched.licenses.sync({"ext": (2, 0)})   # external seats freed: bump
+    assert sched.schedule_cycle(now=3.0) == [jid]
+
+
+def test_skip_refreshes_watchdog_and_coalesces_trace():
+    sched, sim = _cluster(True)
+    sched.submit(spec(held=True), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    walltime0 = sched.stats["last_cycle_walltime"]
+    ring0 = len(sched.cycle_trace)
+    sched.schedule_cycle(now=2.0)
+    sched.schedule_cycle(now=3.0)
+    # liveness refreshed (the watchdog keys off this), cycles counted
+    assert sched.stats["last_cycle_walltime"] >= walltime0
+    assert sched.stats["skipped_cycles"] == 2
+    # consecutive skips coalesce into ONE trace row (skips=2) instead
+    # of flushing the ring with identical no-op entries
+    assert len(sched.cycle_trace) == ring0 + 1
+    row = sched.cycle_trace.snapshot()[-1]
+    assert row["solver"] == "skip"
+    assert row["skip_reason"] == "fingerprint"
+    assert row["skips"] == 2
+
+
+def test_never_skip_with_dispatch_ring():
+    sched, sim = _cluster(True)
+    sched.submit(spec(held=True), now=0.0)
+    sched.schedule_cycle(now=1.0)                # arms
+    dispatched = []
+    sched.dispatch = lambda job, nodes: dispatched.append(job)
+    sched._dispatch_ring.append((None, [], 0, 0, 0))
+    assert sched.schedule_cycle(now=2.0) == []
+    # the ring defeated the fingerprint: a full cycle ran (and drained
+    # the ring through the cycle's durability-ordered path)
+    assert sched.stats["skipped_cycles"] == 0
+    assert dispatched and not sched._dispatch_ring
+
+
+def test_never_arm_with_preemption_configured():
+    from cranesched_tpu.ctld.accounting import AccountManager
+    sched, sim = _cluster(True, preempt_mode="requeue")
+    sched.accounts = AccountManager()
+    sched.submit(spec(held=True), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched._noop_fp is None   # preemption scans can't be skipped
+    sched.schedule_cycle(now=2.0)
+    assert sched.stats["skipped_cycles"] == 0
+
+
+def test_delta_snapshot_matches_full_rebuild():
+    sched, sim = _cluster(True, num_nodes=6)
+    meta = sched.meta
+    for i in range(8):
+        sched.submit(spec(sim_runtime=3.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    meta.drain(2, True)
+    sim.advance_to(5.0)
+    sched.schedule_cycle(now=5.0)
+    meta.snapshot()                        # patches post-cycle dirt
+    a1, t1, al1 = [np.asarray(x).copy() for x in meta.snapshot()]
+    assert meta.last_snapshot_dirty == 0   # second call: clean cache
+    meta._snap = None                      # force the full rebuild
+    a2, t2, al2 = meta.snapshot()
+    assert np.array_equal(a1, np.asarray(a2))
+    assert np.array_equal(t1, np.asarray(t2))
+    assert np.array_equal(al1, np.asarray(al2))
+
+
+def test_event_kicks_and_pending_gauge():
+    from cranesched_tpu.obs import REGISTRY
+    sched, sim = _cluster(True)
+    kicks = []
+    sched.cycle_kick = lambda: kicks.append(1)
+    jid = sched.submit(spec(sim_runtime=1.0), now=0.0)
+    assert kicks, "submit must kick the cycle loop"
+    # queue-depth gauge moves ON the event, not at the next cycle start
+    gauge = REGISTRY.gauge("crane_pending_jobs")
+    assert gauge.value() == len(sched.pending)
+    sched.schedule_cycle(now=1.0)
+    kicks.clear()
+    sim.advance_to(3.0)                    # completion event
+    assert kicks, "status changes must kick the cycle loop"
+    sched.schedule_cycle(now=3.0)
+    assert gauge.value() == 0
+    assert jid in sched.history
+
+
+def test_step_report_close_kicks():
+    # real craneds report batch step 0 via step_report DIRECTLY under
+    # the server lock; the job-level close it enqueues must wake an
+    # idle-sleeping loop or the job stays RUNNING until the fallback
+    # timer (regression: test_x11 hung at RUNNING for its full poll)
+    from cranesched_tpu.ctld.defs import StepStatus
+    sched, sim = _cluster(True)
+    jid = sched.submit(spec(time_limit=60.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert jid in sched.running
+    kicks = []
+    sched.cycle_kick = lambda: kicks.append(1)
+    sched.step_report(jid, 0, StepStatus.COMPLETED, 0, now=2.0)
+    assert kicks, "step-0 close must kick the cycle loop"
+    assert sched._status_queue and not sched.can_idle()
+    sched.schedule_cycle(now=2.5)
+    assert jid in sched.history
+
+
+def test_idle_sleep_wakeup_bounds():
+    sched, sim = _cluster(True)
+    sched.submit(spec(begin_time=50.0), now=0.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.can_idle()
+    # the loop may sleep, but only to the begin_time edge
+    assert sched.next_wake_time(2.0) == 50.0
+    jid2 = sched.submit(spec(sim_runtime=1.0), now=2.0)
+    assert not sched.can_idle()            # new work: no idling
+    assert sched.schedule_cycle(now=3.0) == [jid2]

@@ -14,7 +14,8 @@ out=$(timeout -k 10 600 env JAX_PLATFORMS=cpu \
   BENCH_JOBS=2048 BENCH_NODES=256 BENCH_REPEATS=2 BENCH_SOLVER=native \
   BENCH_SCHED_JOBS=2048 BENCH_SCHED_NODES=256 \
   BENCH_COMMIT_JOBS=2048 BENCH_COMMIT_NODES=256 \
-  python bench.py)
+  BENCH_CHURN_JOBS=8192 BENCH_CHURN_NODES=128 BENCH_CHURN_CYCLES=3 \
+  python bench.py --churn)
 echo "$out"
 python - "$out" <<'PY'
 import json
@@ -38,8 +39,21 @@ cb = doc["detail"]["commit"]
 assert cb and "error" not in cb, f"commit bench failed: {cb}"
 assert cb["fsyncs_equal_groups"] and cb["groups_le_3"], (
     f"group commit broke its fsync amortization contract: {cb}")
+# incremental-cycle guards: an idle tick must actually hit the no-op
+# fingerprint, and cost <5% of a full cycle's wall time
+ch = doc["detail"]["churn"]
+assert ch and "error" not in ch, f"churn bench failed: {ch}"
+assert ch["idle_skipped"], (
+    f"idle tick did not short-circuit (fingerprint never armed): {ch}")
+assert ch["idle_tick_share"] < 0.05, (
+    f"skipped idle cycle cost {ch['idle_tick_share']:.1%} of a full "
+    f"cycle (limit 5%): {ch}")
+assert ch["placements_match"], (
+    f"incremental vs rebuild placed different first waves: {ch}")
 print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"lock_held_share={lock_share:.3f} "
       f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
+      f"churn_prelude_speedup={ch['prelude_speedup']} "
+      f"idle_tick_share={ch['idle_tick_share']} "
       f"solver={sc['solver']}")
 PY
