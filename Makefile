@@ -27,9 +27,16 @@
 #              tests/test_delta_cycle.py): PendingTable/delta-snapshot
 #              oracle parity vs the from-scratch rebuild, no-op
 #              fingerprint re-arm/skip guards, event-driven wakeups.
+# tier1-resident — device-resident cluster-state lane
+#              (@pytest.mark.resident in tests/test_resident_state.py):
+#              steady-state patch (no full [N,R] rebuild), donation
+#              ownership discipline, invalidation epochs (mask table,
+#              node re-register, topology, backend switch), and the
+#              randomized event-script parity oracle vs the rebuild
+#              path.
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
-	tier1-delta
+	tier1-delta tier1-resident
 
 tier1:
 	bash tools/tier1.sh
@@ -57,4 +64,8 @@ tier1-topo:
 
 tier1-delta:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m delta \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-resident:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resident \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -113,7 +113,8 @@ def _build_sched(num_jobs: int, num_nodes: int, wal_dir=None):
         wal = WriteAheadLog(os.path.join(wal_dir, "bench.wal"),
                             fsync=True)
     sched = JobScheduler(meta, SchedulerConfig(
-        schedule_batch_size=num_jobs, backfill_max_jobs=num_jobs),
+        schedule_batch_size=num_jobs, backfill_max_jobs=num_jobs,
+        solver=os.environ.get("BENCH_SCHED_SOLVER", "auto")),
         wal=wal)
 
     def submit(k, now):
@@ -159,6 +160,16 @@ def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
     out["jobs"] = num_jobs
     out["nodes"] = num_nodes
     out["wal_fsyncs_per_cycle"] = int(trace.get("wal_fsyncs", 0))
+    # device-resident pipeline shape (ctld/resident.py): zero bytes /
+    # "off" when the configured backend never acquires the resident
+    # state (e.g. the native CPU solver)
+    res = getattr(sched, "_resident", None)
+    out["resident_mode"] = trace.get(
+        "resident", (res.last_mode or "off") if res else "off")
+    out["host_to_device_bytes_per_cycle"] = int(
+        trace.get("h2d_bytes", 0) or 0)
+    out["patch_overlap_share"] = round(
+        res.overlap_share() if res else 0.0, 4)
     total = max(float(trace.get("total_ms", 0.0)), 1e-9)
     out["prelude_share"] = round(
         float(trace.get("prelude_ms", 0.0)) / total, 4)
@@ -208,7 +219,8 @@ def _measure_commit(num_jobs: int = 10_000,
 
 
 def _build_churn_sched(num_jobs: int, num_nodes: int,
-                       incremental: bool):
+                       incremental: bool, solver: str = "auto",
+                       resident: bool = True):
     """Small cluster + big queue for the churn scenario: after the
     first cycle fills the nodes, the residual queue is steady-state
     pending — exactly the shape where the incremental prelude should
@@ -234,7 +246,8 @@ def _build_churn_sched(num_jobs: int, num_nodes: int,
     # scenario measures the immediate-fit steady state
     sched = JobScheduler(meta, SchedulerConfig(
         schedule_batch_size=num_jobs, backfill=False,
-        incremental=incremental))
+        incremental=incremental, solver=solver,
+        resident_state=resident))
     rng = np.random.default_rng(42)
 
     def spec():
@@ -256,15 +269,18 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
     both, the dirty-row counts, and the cost of a fingerprint-hit idle
     tick relative to a full cycle."""
 
-    def run(incremental: bool) -> dict:
+    def run(incremental: bool, solver: str = "auto",
+            resident: bool = True) -> dict:
         sched, spec, rng = _build_churn_sched(num_jobs, num_nodes,
-                                              incremental)
+                                              incremental, solver,
+                                              resident)
         for _ in range(num_jobs):
             sched.submit(spec(), now=0.0)
         started = len(sched.schedule_cycle(now=1.0))  # fills + compiles
         sched.schedule_cycle(now=2.0)  # steady-state (zero-place) shape
         k = max(int(len(sched.pending) * churn), 1)
         preludes, totals, dirty = [], [], []
+        h2d_bytes, h2d_rows, dirty_nodes, modes = [], [], [], []
         now = 3.0
         for _ in range(cycles):
             pend_ids = list(sched.pending.keys())
@@ -277,6 +293,10 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
             preludes.append(float(tr.get("prelude_ms", 0.0)))
             totals.append(float(tr.get("total_ms", 0.0)))
             dirty.append(int(tr.get("dirty_jobs") or 0))
+            h2d_bytes.append(int(tr.get("h2d_bytes") or 0))
+            h2d_rows.append(int(tr.get("h2d_rows") or 0))
+            dirty_nodes.append(int(tr.get("dirty_nodes") or 0))
+            modes.append(tr.get("resident", "off"))
             now += 1.0
         # idle tick: the last cycle placed nothing, so the fingerprint
         # is armed on the incremental path; the next no-event cycle
@@ -285,11 +305,20 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         t0 = time.perf_counter()
         sched.schedule_cycle(now=now)
         idle_ms = (time.perf_counter() - t0) * 1e3
+        res = sched._resident
         return {
+            "num_dims": int(sched.meta.layout.num_dims),
             "first_cycle_started": started,
             "prelude_ms": round(float(np.median(preludes)), 3),
             "total_ms": round(float(np.median(totals)), 3),
             "dirty_rows": int(np.median(dirty)),
+            "dirty_nodes": int(np.median(dirty_nodes)),
+            "h2d_bytes_per_cycle": int(np.median(h2d_bytes)),
+            "h2d_rows_per_cycle": int(np.median(h2d_rows)),
+            "resident_modes": modes,
+            "full_rebuilds": int(res.full_rebuilds),
+            "patch_cycles": int(res.patch_cycles),
+            "patch_overlap_share": round(res.overlap_share(), 4),
             "idle_tick_ms": round(idle_ms, 3),
             "skipped_cycles": (sched.stats.get("skipped_cycles", 0)
                                - skipped0),
@@ -297,11 +326,46 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
 
     inc = run(True)
     base = run(False)
+    # resident-state acceptance legs (ISSUE 11): same seed/event stream
+    # on the device scan solver, resident patching vs per-cycle rebuild
+    res_on = run(True, solver="device", resident=True)
+    res_off = run(True, solver="device", resident=False)
     full_ms = max(inc["total_ms"], 1e-9)
+    from cranesched_tpu.ctld.resident import (
+        full_state_bytes, padded_rows, patch_row_bytes)
+    num_dims = res_on["num_dims"]
+    # independent dirty-rows bound: the rows the delta snapshot itself
+    # re-read this cycle (trace dirty_nodes) plus the full [N] cost
+    # seed — a silent full-rebuild regression blows straight past it
+    bound = (padded_rows(max(res_on["dirty_nodes"], 1), num_nodes)
+             * patch_row_bytes(num_dims) + 4 * num_nodes)
+    steady = res_on["resident_modes"]
+    resident = {
+        "cycle_ms": res_on["total_ms"],
+        "rebuild_cycle_ms": res_off["total_ms"],
+        "speedup_vs_rebuild": round(
+            res_off["total_ms"] / max(res_on["total_ms"], 1e-9), 2),
+        "h2d_bytes_per_cycle": res_on["h2d_bytes_per_cycle"],
+        "h2d_rows_per_cycle": res_on["h2d_rows_per_cycle"],
+        "dirty_nodes": res_on["dirty_nodes"],
+        "dirty_bound_bytes": int(bound),
+        "full_state_bytes": int(
+            full_state_bytes(num_nodes, num_dims)),
+        "steady_state_patch": bool(
+            steady and all(m == "patch" for m in steady)),
+        "full_rebuilds": res_on["full_rebuilds"],
+        "patch_cycles": res_on["patch_cycles"],
+        "patch_overlap_share": res_on["patch_overlap_share"],
+        "placements_match": bool(
+            res_on["first_cycle_started"]
+            == res_off["first_cycle_started"]
+            == inc["first_cycle_started"]),
+    }
     return {
         "jobs": num_jobs, "nodes": num_nodes, "churn": churn,
         "cycles": cycles,
         "incremental": inc, "full_rebuild": base,
+        "resident": resident,
         # same seed + same event stream: identical first-wave placement
         # is the in-bench parity check (the real oracle lives in
         # tests/test_delta_cycle.py)

@@ -172,6 +172,13 @@ class MetaContainer:
         # interconnect topology (topo.model.Topology), attached via
         # set_topology() once the node registry is complete
         self.topology = None
+        # dirty-row fan-out beyond the snapshot cache: callables
+        # ``fn(node_id)`` invoked from _touch_node on every
+        # snapshot-relevant mutation.  The device-resident cluster
+        # state (ctld/resident.py) registers here so it can scatter-
+        # patch exactly the rows that moved instead of re-uploading
+        # [N, R] every cycle.
+        self.dirty_listeners: list = []
 
     # ---- partitions & node registry ----
 
@@ -422,6 +429,8 @@ class MetaContainer:
         self.meta_epoch += 1
         if self._snap is not None:
             self._dirty_nodes.add(node_id)
+        for fn in self.dirty_listeners:
+            fn(node_id)
 
     def snapshot(self):
         """Dense SoA arrays for the device solve, aligned by node_id.

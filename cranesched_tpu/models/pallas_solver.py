@@ -496,36 +496,45 @@ def solve_greedy_pallas_auto(state: ClusterState, req, node_num,
                              max_nodes: int = 1, block_jobs: int = 256,
                              max_streams: int = 4,
                              interpret: bool = False,
-                             donate: bool = False, plan=None
-                             ) -> tuple[Placements, ClusterState]:
+                             donate: bool = False, plan=None,
+                             return_plan: bool = False):
     """Dispatch: streamed kernel when eligibility classes are disjoint
     and balanced enough to profit, serial single-kernel otherwise.
     Semantics are identical either way (tests/test_pallas_parity.py).
 
     ``plan`` short-circuits the host-side planner with a precomputed
     ``plan_streams`` result (the scheduler caches it per mask-table
-    epoch so steady-state cycles skip the [C, N] host reduction)."""
+    epoch so steady-state cycles skip the [C, N] host reduction).
+
+    ``return_plan=True`` appends the plan this call *actually ran with*
+    (None for the serial kernel) to the result tuple, so callers that
+    pass ``plan=None`` — letting the internal planner decide — can
+    still record the true stream count instead of guessing."""
     if plan is None:
         plan = plan_streams(job_class, class_masks,
                             max_streams=max_streams,
                             block_jobs=block_jobs)
     if plan is None:
-        return solve_greedy_pallas(
+        out = solve_greedy_pallas(
             state, req, node_num, time_limit, valid, job_class,
             class_masks, max_nodes=max_nodes, block_jobs=block_jobs,
             interpret=interpret, donate=donate)
+        return (*out, None) if return_plan else out
     stream_of_class, S, L = plan
-    return _solve_streamed(
+    out = _solve_streamed(
         state, req, node_num, time_limit, valid, job_class, class_masks,
         stream_of_class, max_nodes=max_nodes, block_jobs=block_jobs,
         num_streams=S, stream_len=L, interpret=interpret, donate=donate)
+    return (*out, plan) if return_plan else out
 
 
 def solve_greedy_pallas_from_batch(state: ClusterState, jobs: JobBatch,
                                    max_nodes: int = 1,
                                    interpret: bool = False,
-                                   donate: bool = False
-                                   ) -> tuple[Placements, ClusterState]:
+                                   donate: bool = False,
+                                   block_jobs: int = 256,
+                                   max_streams: int = 4,
+                                   return_plan: bool = False):
     """Adapter for callers holding a dense part_mask (tests, small
     cycles): compress to eligibility classes host-side, then run the
     auto dispatch — real scheduler cycles get the S-stream kernel
@@ -535,4 +544,6 @@ def solve_greedy_pallas_from_batch(state: ClusterState, jobs: JobBatch,
     return solve_greedy_pallas_auto(
         state, jobs.req, jobs.node_num, jobs.time_limit, jobs.valid,
         jnp.asarray(job_class), jnp.asarray(class_masks),
-        max_nodes=max_nodes, interpret=interpret, donate=donate)
+        max_nodes=max_nodes, block_jobs=block_jobs,
+        max_streams=max_streams, interpret=interpret, donate=donate,
+        return_plan=return_plan)

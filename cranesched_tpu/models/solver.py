@@ -251,6 +251,50 @@ def make_cluster_state(avail, total, alive, cost=None) -> ClusterState:
     return ClusterState(avail=avail, total=total, alive=alive, cost=cost)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _patch_cluster_state(state: ClusterState, dirty_idx, avail_rows,
+                         total_rows, alive_rows, cost) -> ClusterState:
+    return state.replace(
+        avail=state.avail.at[dirty_idx].set(avail_rows, mode="drop"),
+        total=state.total.at[dirty_idx].set(total_rows, mode="drop"),
+        alive=state.alive.at[dirty_idx].set(alive_rows, mode="drop"),
+        cost=cost)
+
+
+def patch_cluster_state(state: ClusterState, dirty_idx, avail_rows,
+                        total_rows, alive_rows, cost) -> ClusterState:
+    """Scatter-patch a device-resident ClusterState in place: overwrite
+    rows ``dirty_idx`` of avail/total/alive with the host's current
+    values and replace the whole cost ledger (the cost seed is
+    time-dependent — it changes for EVERY node every cycle — so it
+    ships full as [N] int32; the [N, R] tensors ship only dirty rows).
+
+    The input state's buffers are DONATED: on TPU the scatter rewrites
+    them in place and the caller must never touch ``state`` again
+    (ctld/resident.py owns that discipline).  ``dirty_idx`` may be
+    padded with out-of-range indices (>= N) — ``mode="drop"`` discards
+    them — so callers can bucket the dirty-row count to a small set of
+    static shapes without a mask argument."""
+    cost = normalize_cost_ledger(cost, state.num_nodes)
+    return _patch_cluster_state(
+        state, jnp.asarray(dirty_idx, jnp.int32),
+        jnp.asarray(avail_rows, jnp.int32),
+        jnp.asarray(total_rows, jnp.int32),
+        jnp.asarray(alive_rows, bool), cost)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _refresh_cost(state: ClusterState, cost) -> ClusterState:
+    return state.replace(cost=cost)
+
+
+def refresh_cost_ledger(state: ClusterState, cost) -> ClusterState:
+    """The empty-delta fast path of patch_cluster_state: no rows moved,
+    so only the time-dependent [N] cost ledger ships.  Same donation
+    contract — never touch the input ``state`` again."""
+    return _refresh_cost(state, normalize_cost_ledger(cost, state.num_nodes))
+
+
 def job_feasibility(avail, alive, part_mask, req):
     """eligible/feasible node masks for one job against one (shard of the)
     cluster — the per-job predicate both solver paths share."""
@@ -350,3 +394,21 @@ def solve_greedy(state: ClusterState, jobs: JobBatch,
 
     new_state = state.replace(avail=avail, cost=cost)
     return Placements(placed=placed, nodes=nodes, reason=reason), new_state
+
+
+# Donating twin of solve_greedy for the device-resident cycle pipeline:
+# the input ClusterState's buffers are donated so XLA writes avail/cost
+# updates into them in place (zero-copy across cycle iterations on TPU;
+# CPU ignores donation).  After calling this the input state is dead —
+# ctld/resident.py enforces that by surrendering ownership on acquire()
+# and re-adopting only the returned state.
+_solve_greedy_donating = functools.partial(
+    jax.jit, static_argnames=("max_nodes",),
+    donate_argnums=(0,))(solve_greedy.__wrapped__)
+
+
+def solve_greedy_donating(state: ClusterState, jobs: JobBatch,
+                          max_nodes: int = 1
+                          ) -> tuple[Placements, ClusterState]:
+    """solve_greedy with ``state`` donated; never reuse the input state."""
+    return _solve_greedy_donating(state, jobs, max_nodes=max_nodes)

@@ -200,7 +200,14 @@ class CraneConfig:
             # no-op fingerprint); off = from-scratch rebuild every tick
             incremental=bool(sc.get("Incremental", True)),
             # provably-idle loop sleep bound (event kicks end it early)
-            cycle_idle_sleep=float(sc.get("CycleIdleSleep", 30)))
+            cycle_idle_sleep=float(sc.get("CycleIdleSleep", 30)),
+            # device-resident ClusterState across cycles (dirty-row
+            # scatter patch instead of a full [N, R] upload per tick)
+            resident_state=bool(sc.get("ResidentState", True)),
+            # S-stream Pallas solve knobs; pin from the measured optimum
+            # in profiles/<device>_STREAMS_PROFILE.md (tools/kstream.py)
+            max_streams=int(sc.get("MaxStreams", 4)),
+            block_jobs=int(sc.get("BlockJobs", 256)))
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)

@@ -50,10 +50,33 @@ assert ch["idle_tick_share"] < 0.05, (
     f"cycle (limit 5%): {ch}")
 assert ch["placements_match"], (
     f"incremental vs rebuild placed different first waves: {ch}")
+# device-resident state guards: steady-state churn cycles must run the
+# dirty-row scatter patch (never a silent full [N,R] rebuild), the
+# host->device bytes must stay under the dirty-rows bound, the delta
+# upload must be double-buffered (staged by the previous cycle), and
+# sched_cycle must report the new pipeline-shape fields for BENCH_r06
+rs = ch["resident"]
+assert rs["steady_state_patch"], (
+    f"a steady churn cycle fell back to a full [N,R] rebuild: {rs}")
+assert rs["h2d_bytes_per_cycle"] <= rs["dirty_bound_bytes"], (
+    f"resident patch shipped {rs['h2d_bytes_per_cycle']}B/cycle, over "
+    f"the dirty-rows bound {rs['dirty_bound_bytes']}B: {rs}")
+assert rs["h2d_bytes_per_cycle"] < rs["full_state_bytes"], (
+    f"resident patch bytes not below a full rebuild: {rs}")
+assert rs["patch_overlap_share"] >= 0.99, (
+    f"delta uploads were not overlapped with the previous cycle "
+    f"(share {rs['patch_overlap_share']}): {rs}")
+assert rs["placements_match"], (
+    f"resident vs rebuild placed different first waves: {rs}")
+assert ("host_to_device_bytes_per_cycle" in sc
+        and "patch_overlap_share" in sc), (
+    f"sched_cycle detail lost the resident pipeline fields: {sc}")
 print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"lock_held_share={lock_share:.3f} "
       f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
       f"churn_prelude_speedup={ch['prelude_speedup']} "
       f"idle_tick_share={ch['idle_tick_share']} "
+      f"resident_h2d_bytes={rs['h2d_bytes_per_cycle']} "
+      f"patch_overlap_share={rs['patch_overlap_share']} "
       f"solver={sc['solver']}")
 PY
