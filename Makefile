@@ -27,6 +27,11 @@
 #              tests/test_delta_cycle.py): PendingTable/delta-snapshot
 #              oracle parity vs the from-scratch rebuild, no-op
 #              fingerprint re-arm/skip guards, event-driven wakeups.
+# tier1-trace — per-job tracing + SLO lane (@pytest.mark.jobtrace in
+#              tests/test_job_trace.py): timeline completeness across
+#              submit/hold/requeue/preempt/HA-failover, gRPC trace
+#              propagation ctld→craned, SLO window/burn math, and the
+#              bounded-ring spill accounting.
 # tier1-resident — device-resident cluster-state lane
 #              (@pytest.mark.resident in tests/test_resident_state.py):
 #              steady-state patch (no full [N,R] rebuild), donation
@@ -36,7 +41,7 @@
 #              path.
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
-	tier1-delta tier1-resident
+	tier1-delta tier1-resident tier1-trace
 
 tier1:
 	bash tools/tier1.sh
@@ -68,4 +73,8 @@ tier1-delta:
 
 tier1-resident:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resident \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-trace:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m jobtrace \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -682,6 +682,18 @@ def _cstats_stalled(doc) -> str | None:
 def cmd_cstats(args) -> int:
     import json as _json
     client = _client(args)
+    if getattr(args, "job", 0):
+        # the timeline rides QueryJobSummary (standby-servable) — no
+        # need to pull the full stats doc
+        reply = client.query_job_summary(job_id=args.job)
+        if not reply.timeline_json:
+            print(f"no timeline recorded for job {args.job}",
+                  file=sys.stderr)
+            return 1
+        from cranesched_tpu.obs.jobtrace import render_waterfall
+        for line in render_waterfall(_json.loads(reply.timeline_json)):
+            print(line)
+        return 0
     doc = _json.loads(client.query_stats().json)
     stalled = _cstats_stalled(doc)
     if stalled:
@@ -721,6 +733,26 @@ def cmd_cstats(args) -> int:
             "PREEMPT", "SKIP", "DIRTY", "PRELUDE_MS", "SOLVE_MS",
             "COMMIT_MS", "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC",
             "FRAG")))
+        return 0
+    if getattr(args, "slo", False):
+        rows = []
+        for slo in doc.get("slo") or ():
+            for win, w in sorted(slo.get("windows", {}).items(),
+                                 key=lambda kv: int(kv[0])):
+                rows.append((
+                    slo.get("name"),
+                    f"{slo.get('from')}->{slo.get('to')}",
+                    f"p{slo.get('p'):g}<={slo.get('target_seconds')}s",
+                    f"{int(win)}s", w.get("count"),
+                    round(float(w.get("observed", 0.0)), 4),
+                    w.get("burn_rate"),
+                    "BREACH" if w.get("breaching") else "ok"))
+        if not rows:
+            print("no SLOs configured (Observability: SLO: in the "
+                  "cluster YAML)", file=sys.stderr)
+            return 1
+        print(_fmt_table(rows, ("SLO", "EDGE", "TARGET", "WINDOW",
+                                "COUNT", "OBSERVED", "BURN", "STATE")))
         return 0
     if getattr(args, "metrics", False):
         rows = []
@@ -1152,6 +1184,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ha", action="store_true",
                    help="print HA role / fencing epoch / replication "
                         "lag as a table")
+    p.add_argument("--job", type=int, default=0, metavar="JOB_ID",
+                   help="print the job's lifecycle timeline as an "
+                        "ASCII waterfall (per-job tracing)")
+    p.add_argument("--slo", action="store_true",
+                   help="print the live SLO table (per-window "
+                        "percentile + burn rate)")
     p.set_defaults(func=cmd_cstats)
 
     p = sub.add_parser("crequeue",

@@ -247,6 +247,13 @@ class CranedDaemon:
         # the late allocation is torn down, not leaked
         self._allocating: dict[int, int] = {}
         self._pending_frees: dict[int, int | None] = {}
+        # per-job trace spans (obs/jobtrace.py craned half): local
+        # lifecycle spans recorded while the push's crane-trace context
+        # is live, shipped back inside the final StepStatusChange
+        self._trace_ctx: dict[tuple[int, int], dict] = {}
+        # last measured ping RTT = the clock-skew bound stamped on
+        # every re-based span (0.0 until the first ping completes)
+        self._last_rtt = 0.0
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._crashed = False   # crash-simulation flag (stop graceful=False)
@@ -287,6 +294,7 @@ class CranedDaemon:
         GRES hold, no supervisor until steps arrive."""
         if err := self._fenced(request):
             return pb.OkReply(ok=False, error=err)
+        self._trace_begin(request, context)
         job_id = request.job_id
         with self._lock:
             self._allocating[job_id] = request.incarnation
@@ -312,6 +320,7 @@ class CranedDaemon:
     def ExecuteStep(self, request, context):
         if err := self._fenced(request):
             return pb.OkReply(ok=False, error=err)
+        self._trace_begin(request, context)
         key = (request.job_id, request.step_id)
         try:
             self._spawn_step(request)
@@ -500,6 +509,65 @@ class CranedDaemon:
         except (BrokenPipeError, ValueError, OSError):
             pass
 
+    # ---- per-job trace spans (obs/jobtrace.py craned half) ----
+
+    def _trace_begin(self, request, context) -> None:
+        """Open the local span list when the push carried crane-trace
+        metadata (``job_id/incarnation/epoch/base_seq``) and stamp
+        ``craned_received``.  Span times are re-based onto the ctld
+        clock via the push's ``now`` anchor (span_t = anchor + local
+        elapsed since receive); the residual skew is bounded by the
+        last measured ping RTT and shipped with every span."""
+        if context is None:
+            return
+        try:
+            md = dict(context.invocation_metadata() or ())
+        except Exception:
+            return
+        raw = md.get("crane-trace")
+        if not raw:
+            return
+        try:
+            job_id, incarnation, _epoch, base_seq = (
+                int(x) for x in raw.split("/"))
+        except ValueError:
+            return
+        if (job_id != request.job_id
+                or incarnation != getattr(request, "incarnation", 0)):
+            return   # metadata for another push: drop, never mislabel
+        ctx = {"base": base_seq, "anchor": float(request.now),
+               "t0": time.perf_counter(), "skew": self._last_rtt,
+               "spans": []}
+        with self._lock:
+            self._trace_ctx[(job_id, incarnation)] = ctx
+        self._trace_mark(job_id, incarnation, "craned_received")
+
+    def _trace_mark(self, job_id: int, incarnation: int,
+                    edge: str) -> None:
+        """Append one span to the job's live trace context (no-op when
+        the push carried no context, e.g. AllocSteps pushes)."""
+        with self._lock:
+            ctx = self._trace_ctx.get((job_id, incarnation))
+            if ctx is None:
+                return
+            if any(s["edge"] == edge for s in ctx["spans"]):
+                return   # spawn retry: the edge already happened once
+            ctx["spans"].append({
+                "edge": edge,
+                "seq": ctx["base"] + len(ctx["spans"]),
+                "t": ctx["anchor"]
+                + (time.perf_counter() - ctx["t0"]),
+                "node_id": (self.node_id
+                            if self.node_id is not None else -1),
+                "skew": ctx["skew"]})
+
+    def _trace_take(self, job_id: int, incarnation: int) -> list[dict]:
+        """Pop the job's local spans for the ship-back (empty when no
+        context was propagated)."""
+        with self._lock:
+            ctx = self._trace_ctx.pop((job_id, incarnation), None)
+        return ctx["spans"] if ctx else []
+
     # ---- step spawning (StepInstance::SpawnSupervisor analog) ----
 
     def _ensure_alloc(self, request, implicit: bool) -> "_Alloc":
@@ -578,6 +646,7 @@ class CranedDaemon:
             self._release_gres(gres_held)
             self._release_cores(cores)
             return winner
+        self._trace_mark(job_id, request.incarnation, "cgroup_ready")
         return alloc
 
     def _maybe_teardown_alloc(self, job_id: int) -> None:
@@ -762,6 +831,7 @@ class CranedDaemon:
             proc.stdin.write(b"GO\n")
             proc.stdin.flush()
             _MET_SPAWN.observe(time.perf_counter() - t_spawn)
+            self._trace_mark(job_id, request.incarnation, "step_start")
         except Exception:
             # every spawn failure must leak nothing: kill AND REAP the
             # process (a cgroup rmdir in the implicit-alloc teardown
@@ -1027,7 +1097,10 @@ class CranedDaemon:
                                           incarnation=step.incarnation,
                                           step_id=step.step_id,
                                           cpu_seconds=cpu_seconds,
-                                          max_rss_bytes=max_rss)
+                                          max_rss_bytes=max_rss,
+                                          spans=self._trace_take(
+                                              step.job_id,
+                                              step.incarnation))
         except (grpc.RpcError, ValueError):
             pass  # ctld down / client closed: the ping timeout + WAL
                   # reconcile at re-registration
@@ -1450,7 +1523,10 @@ class CranedDaemon:
                 ok = self._ctld.craned_ping(self.node_id).ok
             except grpc.RpcError:
                 ok = False
-            _MET_CTLD_RTT.observe(time.perf_counter() - t0, op="ping")
+            rtt = time.perf_counter() - t0
+            _MET_CTLD_RTT.observe(rtt, op="ping")
+            if ok:
+                self._last_rtt = rtt
             if not ok:
                 self.state = CranedState.DISCONNECTED
 

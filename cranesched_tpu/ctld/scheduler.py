@@ -80,6 +80,8 @@ from cranesched_tpu.models.solver_time import (
     solve_backfill,
 )
 from cranesched_tpu.obs import REGISTRY as _OBS
+from cranesched_tpu.obs.jobtrace import JobTraceRecorder
+from cranesched_tpu.obs.slo import SloEngine
 from cranesched_tpu.obs.trace import CycleTraceRing, solve_span
 from cranesched_tpu.topo.place import solve_greedy_topo
 from cranesched_tpu.ops.resources import CPU_SCALE, DIM_CPU, DIM_MEM
@@ -237,6 +239,18 @@ class SchedulerConfig:
     # prints the YAML to pin).
     max_streams: int = 4
     block_jobs: int = 256
+    # per-job lifecycle tracing (YAML ``Observability: JobTrace``):
+    # event-sourced timelines (obs/jobtrace.py) stamped at submit /
+    # candidate / commit / durable-dispatch / terminal edges plus the
+    # craned-side spans shipped back with StepStatusChange.  False
+    # removes every stamp from the hot path.
+    job_trace: bool = True
+    # bounded timeline store size (live + closed, each)
+    job_trace_capacity: int = 4096
+    # SLO targets over trace edges (YAML ``Observability: SLO``),
+    # frozen-dataclass form: tuple of
+    # (name, from_edge, to_edge, percentile, target_seconds, windows)
+    slo: tuple = ()
 
     def __post_init__(self):
         if self.preempt_mode not in ("off", "requeue", "cancel"):
@@ -574,6 +588,27 @@ class JobScheduler:
         # by the server lock, so one slot suffices
         self.cycle_trace = CycleTraceRing(config.cycle_trace_ring)
         self._cur_trace: dict = {}
+        # per-job lifecycle tracing + SLO plane (obs/jobtrace.py,
+        # obs/slo.py): None when JobTrace is off — every stamp site
+        # guards on it, so "off" removes the whole layer from the hot
+        # path, not just the output
+        self.slo_engine = SloEngine.from_config(config.slo)
+        self.jobtrace = (JobTraceRecorder(
+            capacity=config.job_trace_capacity, slo=self.slo_engine)
+            if config.job_trace else None)
+        # the in-flight cycle's ``now``: the dispatch-ring drain runs
+        # lock-released and stamps committed_durable/dispatched on the
+        # same clock the cycle used (virtual in sims, wall in daemons)
+        self._cycle_now = 0.0
+        # timed preemption's deferred evictions: victim job_id ->
+        # (due time, preemptor job_id).  Victims of a future-start
+        # preemption survive until the preemptor's start bucket
+        # (reference JobScheduler.cpp:6378-6505); the prelude drains
+        # entries whose due time passed, next_wake_time() wakes the
+        # event loop for the earliest one.  Deliberately NOT
+        # WAL-persisted: after a failover the preemption solve
+        # re-derives any eviction still worth making.
+        self._deferred_evictions: dict[int, tuple[float, int]] = {}
         if archive is not None:
             self.attach_archive(archive)
 
@@ -813,7 +848,8 @@ class JobScheduler:
                 and not self._step_report_queue
                 and not self._cancel_kill_sent
                 and not self._step_cancel_sent
-                and not self._limit_intents)
+                and not self._limit_intents
+                and not self._deferred_evictions)
 
     def next_wake_time(self, now: float) -> float:
         """Earliest future moment a sleeping loop must cycle even
@@ -829,6 +865,8 @@ class JobScheduler:
         if any(node.alive and node.expect_pings
                for node in self.meta.nodes.values()):
             wake = min(wake, now + self.config.craned_timeout / 2)
+        for due, _preemptor in self._deferred_evictions.values():
+            wake = min(wake, due)
         return wake
 
     # ------------------------------------------------------------------
@@ -936,6 +974,8 @@ class JobScheduler:
         self.pending[job_id] = job
         if self.wal is not None:
             self.wal.job_submitted(job)
+        if self.jobtrace is not None:
+            self.jobtrace.stamp(job_id, 0, "submit", now)
         return job_id
 
     # ------------------------------------------------------------------
@@ -1117,6 +1157,9 @@ class JobScheduler:
         self._release_job_resources(job)
         del self.running[job_id]
         self._cancel_kill_sent.pop(job_id, None)
+        if self.jobtrace is not None:
+            self.jobtrace.stamp(job_id, job.requeue_count, "requeue",
+                                now)
         job.reset_for_requeue()
         if job.requeue_count > self.config.max_requeue_count:
             job.held = True
@@ -1301,6 +1344,39 @@ class JobScheduler:
                          incarnation=queue_incarnation))
         self._kick()   # Event.set is thread-safe (transport threads)
 
+    def record_remote_spans(self, job_id: int, incarnation: int,
+                            spans) -> int:
+        """Merge craned-side spans (craned_received / cgroup_ready /
+        step_start) shipped back inside StepStatusChange into the job's
+        timeline.  Each span keeps its original seq from the propagated
+        trace context, so the merged timeline stays monotone; stamp-once
+        drops duplicates from retried RPCs.  Thread-safe (recorder lock);
+        returns the number of spans newly recorded."""
+        if self.jobtrace is None:
+            return 0
+        n = 0
+        for s in spans:
+            edge = s["edge"] if isinstance(s, dict) else s.edge
+            if isinstance(s, dict):
+                t, seq = s["t"], s.get("seq")
+                node_id = s.get("node_id", -1)
+                skew = s.get("skew", 0.0)
+            else:
+                t, seq, node_id, skew = s.time, s.seq, s.node_id, s.skew
+            if self.jobtrace.stamp(job_id, incarnation, edge, float(t),
+                                   node_id=int(node_id),
+                                   skew=float(skew), seq=int(seq)):
+                n += 1
+        return n
+
+    def trace_seq(self, job_id: int, incarnation: int) -> int:
+        """Next span seq for (job_id, incarnation) — the base the
+        dispatcher embeds in the crane-trace gRPC metadata so craned
+        numbers its local spans after the ctld-side ones."""
+        if self.jobtrace is None:
+            return 0
+        return self.jobtrace.next_seq(job_id, incarnation)
+
     def step_report_async(self, job_id: int, step_id: int,
                           status: "StepStatus", exit_code: int,
                           now: float,
@@ -1347,6 +1423,9 @@ class JobScheduler:
             job.exit_code = ch.exit_code
             job.status = ch.status
             if self._should_requeue(job, ch):
+                if self.jobtrace is not None:
+                    self.jobtrace.stamp(job.job_id, job.requeue_count,
+                                        "requeue", ch.time)
                 job.reset_for_requeue()
                 if job.requeue_count > self.config.max_requeue_count:
                     # over the cap: requeued but held (reference keeps the
@@ -1468,6 +1547,11 @@ class JobScheduler:
                 step.status = StepStatus.CANCELLED
                 step.exit_code = 130
             step.end_time = job.end_time
+        if self.jobtrace is not None:
+            t = (job.end_time if job.end_time is not None
+                 else (job.start_time or job.submit_time))
+            self.jobtrace.stamp(job.job_id, job.requeue_count, "end", t,
+                                epoch=self.fencing_epoch)
         self._finalize(job)
         self._trigger_dep_event(job)
         if job.array_parent_id is not None:
@@ -2069,6 +2153,22 @@ class JobScheduler:
             items = [it for it in items if it[4] <= durable]
             if not items:
                 return 0
+        trace = self.jobtrace
+        if trace is not None:
+            # past the durability filter == the WAL group-commit
+            # watermark covers each job's start record.  "dispatched"
+            # is stamped as the push is ISSUED (the grpc dispatcher
+            # pushes from pool threads; the sim plane runs inline and
+            # stamps its craned-side spans during the call below, which
+            # must sequence after these two).
+            t = self._cycle_now
+            for job, _nodes, inc, epoch, _seq in items:
+                if job is None:  # dropped entry (cancelled at commit)
+                    continue
+                trace.stamp(job.job_id, inc, "committed_durable", t,
+                            epoch=epoch)
+                trace.stamp(job.job_id, inc, "dispatched", t,
+                            epoch=epoch)
         if self.dispatch_batch is not None:
             self.dispatch_batch(items)
         else:
@@ -2110,9 +2210,11 @@ class JobScheduler:
             "preempted": 0, "backfilled": 0, "num_streams": 1,
         }
         _MET_PENDING.set(len(self.pending))
+        self._cycle_now = now
         self.process_status_changes()
         self._check_craned_timeouts(now)
         self._check_alloc_timeouts(now)
+        self._drain_deferred_evictions(now)
         self._renew_cancel_intents(now)
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
@@ -2134,6 +2236,19 @@ class JobScheduler:
         self.stats["cycles"] += 1
         _MET_CYCLES.inc()
         candidates = self._pending_candidates(now)
+        if self.jobtrace is not None and candidates:
+            # first-sight "eligible" stamp per incarnation; the Job
+            # attribute guard keeps repeat cycles at one attr probe per
+            # candidate (the recorder's set probe would already be
+            # cheap, but this avoids even its lock on the common path)
+            fresh = []
+            for job in candidates:
+                if getattr(job, "_trace_eligible", -1) != \
+                        job.requeue_count:
+                    job._trace_eligible = job.requeue_count
+                    fresh.append((job.job_id, job.requeue_count))
+            if fresh:
+                self.jobtrace.stamp_many("eligible", fresh, now)
         if not candidates:
             # empty cycles still refresh the liveness timestamp (the
             # watchdog's stall detection keys off it) but don't enter
@@ -3087,12 +3202,23 @@ class JobScheduler:
                          for vi in np.nonzero(evict_mat[i])[0]
                          if vi < len(victims)]
             if start_buckets is not None and start_buckets[i] > 0:
-                # future-start preemption: kill only, start later.
-                # Without victims to kill there is nothing to commit —
-                # plain waiting is the backfill solver's job.
+                # Future-start preemption: the preemptor cannot start
+                # until its start bucket, so killing the victims NOW
+                # would strand their resources idle for the whole gap
+                # (the documented divergence in models/preempt_time.py;
+                # reference JobScheduler.cpp:6378-6505 keeps victims
+                # running).  Defer the eviction to the start-bucket
+                # edge instead: the event-driven loop wakes via
+                # next_wake_time and the cycle prelude drains due
+                # entries.  Re-solving each cycle refreshes the due
+                # time, and a preemptor that gets placed (or cancelled)
+                # before then releases its victims unharmed.
                 if evict_ids:
+                    due = now + float(self._grid.edges[
+                        min(int(start_buckets[i]), T)])
                     for victim_id in evict_ids:
-                        self._evict(victim_id, now)
+                        self._deferred_evictions[victim_id] = (
+                            due, job.job_id)
                     job.pending_reason = PendingReason.PRIORITY
                 continue
             if self._commit_preemption(job, chosen, evict_ids,
@@ -3148,11 +3274,36 @@ class JobScheduler:
         self._ledger_add(job, now)
         if self.wal is not None:
             self.wal.job_started(job)
+        if self.jobtrace is not None:
+            self.jobtrace.stamp(job.job_id, job.requeue_count, "placed",
+                                now, epoch=self.fencing_epoch)
         self._trigger_dep_event(job)
         # onto the ring: the push goes out post-lock, after the cycle's
         # WAL group (holding this start record) is durable
         self._queue_dispatch(job, chosen)
         return True
+
+    def _drain_deferred_evictions(self, now: float) -> None:
+        """Fire timed-preemption evictions whose start bucket arrived.
+
+        Entries are claims, not commitments: each cycle's solve rewrites
+        the due time, and a claim is void the moment its preemptor left
+        the pending queue (placed, cancelled, held) or the victim ended
+        on its own — void entries are dropped without killing anything.
+        Not WAL-persisted: after a failover the promoted leader's first
+        solve re-derives the same claims from the same pending state."""
+        if not self._deferred_evictions:
+            return
+        for victim_id in list(self._deferred_evictions):
+            due, preemptor_id = self._deferred_evictions[victim_id]
+            preemptor = self.pending.get(preemptor_id)
+            if (preemptor is None or preemptor.held
+                    or victim_id not in self.running):
+                del self._deferred_evictions[victim_id]
+                continue
+            if due <= now:
+                del self._deferred_evictions[victim_id]
+                self._evict(victim_id, now)
 
     def _evict(self, victim_id: int, now: float) -> None:
         """Evict a running job for a preemptor: kill its steps, free its
@@ -3182,6 +3333,9 @@ class JobScheduler:
             self._finalize_terminal(victim)
             return
         if self.config.preempt_mode == "requeue":
+            if self.jobtrace is not None:
+                self.jobtrace.stamp(victim_id, victim.requeue_count,
+                                    "requeue", now)
             victim.reset_for_requeue()
             victim.pending_reason = PendingReason.PREEMPTED
             if victim.requeue_count > self.config.max_requeue_count:
@@ -3672,9 +3826,13 @@ class JobScheduler:
         self._ledger_add_batch(started_jobs, now)
         _MET_COMMIT_BATCH.observe(len(started_jobs))
         wal = self.wal
+        trace = self.jobtrace
         for job in started_jobs:
             if wal is not None:
                 wal.job_started(job)  # buffered into the cycle's group
+            if trace is not None:
+                trace.stamp(job.job_id, job.requeue_count, "placed",
+                            now, epoch=self.fencing_epoch)
             self._trigger_dep_event(job)   # AFTER edges fire on start
             self._queue_dispatch(job, job.node_ids)
         return started
@@ -3759,6 +3917,14 @@ class JobScheduler:
             else:
                 job.status = JobStatus.PENDING
                 self.pending[job_id] = job
+        if self.jobtrace is not None:
+            # Seed timelines for every replayed job: synthetic spans
+            # back-date the edges the WAL proves were passed, so the
+            # lost/doubled ledger and cstats --job stay meaningful
+            # across a failover.  Stamp-once makes this a no-op for
+            # spans a promoted standby already holds.
+            for job_id, (_event, job) in sorted(replayed.items()):
+                self.jobtrace.seed_recovered(job, now)
         # re-derive waiting edges against the CURRENT state of each
         # dependee (events that fired between the WAL snapshot and the
         # crash would otherwise be lost forever), then rebuild the

@@ -22,15 +22,16 @@ solver (models/solver_time.py):
   is evicted iff some bucket of the placement window still lacks
   resources given everything earlier rows (in the host's pre-sorted
   lowest-QoS-first, youngest-first order) already free.
-* Commit semantics (documented divergence): victims die at commit time
-  (now) while the preemptor occupies ``[s, s + dur)`` — killing earlier
-  than strictly needed is conservative for the preemptor and keeps the
-  host commit identical to the immediate path; the freed interval
-  ``[0, end_row)`` returns to the time map so in-cycle backfill can use
-  it.
-
-The host commits decisions exactly like timed placements: ``s == 0``
-rows dispatch now, later rows hold in-cycle reservations.
+* Commit semantics: ``s == 0`` rows evict-and-dispatch now, exactly
+  like the immediate path.  ``s > 0`` rows DEFER the kill — the host
+  records a (victim -> due, preemptor) claim and the event-driven loop
+  evicts at the start-bucket edge (``JobScheduler._drain_deferred_
+  evictions``), matching the reference, which keeps victims running
+  until the preemptor actually starts (TryPreempt_ cpp:6378-6505).
+  Claims are re-derived every cycle from a fresh solve, so a preemptor
+  that places, cancels, or loses its slot releases its victims
+  unharmed, and the victims' resources stay in the ledger (visible to
+  every other solve) until the kill really happens.
 """
 
 from __future__ import annotations

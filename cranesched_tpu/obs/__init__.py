@@ -1,16 +1,26 @@
-"""Observability layer: dependency-free metrics, cycle tracing, and the
-scheduler watchdog (round 6).
+"""Observability layer: dependency-free metrics, cycle tracing, per-job
+lifecycle tracing with SLOs, and the scheduler watchdog.
 
-- ``metrics.py``  process-wide registry of counters / gauges /
-                  histograms with Prometheus text exposition and a
-                  stdlib HTTP endpoint (no prometheus_client dep).
-- ``trace.py``    bounded ring of structured per-cycle traces plus the
-                  jax.profiler span helper used around solve closures.
+- ``metrics.py``   process-wide registry of counters / gauges /
+                   histograms with Prometheus text exposition and a
+                   stdlib HTTP endpoint (no prometheus_client dep).
+- ``trace.py``     bounded ring of structured per-cycle traces plus the
+                   jax.profiler span helper used around solve closures.
+- ``jobtrace.py``  event-sourced per-job timelines (one span per
+                   lifecycle edge, ctld + craned clock domains) and the
+                   derived latency histograms / exemplars.
+- ``slo.py``       sliding-window p50/p99 targets over trace edges with
+                   multi-window burn-rate gauges and a breach counter.
 
-See ARCHITECTURE.md ("Observability") for the metric naming scheme and
-the cycle-trace schema.
+See ARCHITECTURE.md ("Observability" and "Per-job tracing and SLOs")
+for the metric naming scheme and the timeline schema.
 """
 
+from cranesched_tpu.obs.jobtrace import (  # noqa: F401
+    SPAN_EDGES,
+    JobTraceRecorder,
+    render_waterfall,
+)
 from cranesched_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -18,6 +28,10 @@ from cranesched_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     REGISTRY,
     serve_metrics,
+)
+from cranesched_tpu.obs.slo import (  # noqa: F401
+    SloEngine,
+    SloSpec,
 )
 from cranesched_tpu.obs.trace import (  # noqa: F401
     CycleTraceRing,

@@ -145,6 +145,86 @@ def _run_rpc(sched, sim, specs, wal_path: str | None = None,
     )
 
 
+# SLO targets for the closed-loop mode (virtual-clock seconds).  The
+# windows are sized to the replay drains (hundreds to thousands of
+# virtual seconds) so the final evaluate() still sees every sample;
+# the queue-wait target is deliberately loose — the assertion is about
+# the plumbing (gauges exported, burn math running), not queue policy.
+REPLAY_SLOS = (
+    ("submit-to-start", "submit", "step_start", 99.0, 86400.0,
+     (3600.0, 86400.0)),
+    ("commit-to-node", "committed_durable", "craned_received", 99.0,
+     5.0, (3600.0, 86400.0)),
+)
+
+
+def _run_closed_loop(sched, sim, specs, wal_path: str | None = None,
+                     max_cycles=100_000):
+    """SLO-asserted closed loop (REPLAY_r06): the full RPC path, after
+    which the run audits itself from its own telemetry — the timeline
+    ledger proves no job was lost or double-finalized, every finished
+    job's span sum matches the wall clock within its recorded skew
+    bound, and the burn-rate gauges are live on /metrics."""
+    from cranesched_tpu.obs.metrics import REGISTRY
+    from cranesched_tpu.obs.slo import SloEngine
+
+    if sched.jobtrace is None:
+        raise RuntimeError("closed-loop replay needs JobTrace on")
+    eng = SloEngine.from_config(REPLAY_SLOS)
+    sched.slo_engine = eng
+    sched.jobtrace.slo = eng
+    # the audit reads every timeline back, so the rings must outlive
+    # the whole trace (the default capacity is sized for a live ctld)
+    sched.jobtrace.capacity = max(sched.jobtrace.capacity,
+                                  4 * len(specs))
+    out = _run_rpc(sched, sim, specs, wal_path=wal_path,
+                   max_cycles=max_cycles)
+
+    ids = sorted(sched.history)
+    ledger = sched.jobtrace.ledger(ids)
+    checked = matched = 0
+    worst = 0.0
+    for jid, job in sched.history.items():
+        doc = sched.jobtrace.timeline(jid)
+        if (doc is None or job.end_time is None
+                or job.submit_time is None):
+            continue
+        first = doc["incarnations"][0]["spans"]
+        last = doc["incarnations"][-1]["spans"]
+        t_submit = next((s["t"] for s in first
+                         if s["edge"] == "submit"), None)
+        t_end = next((s["t"] for s in last if s["edge"] == "end"),
+                     None)
+        if t_submit is None or t_end is None:
+            continue
+        skew = max((s.get("skew", 0.0)
+                    for inc in doc["incarnations"]
+                    for s in inc["spans"]), default=0.0)
+        err = abs((t_end - t_submit)
+                  - (job.end_time - job.submit_time))
+        checked += 1
+        worst = max(worst, err)
+        if err <= skew + 1e-6:
+            matched += 1
+    table = eng.evaluate(sim.now)
+    text = REGISTRY.expose()
+    out["slo_assert"] = {
+        "ledger": ledger,
+        "span_sum_checked": checked,
+        "span_sum_matched": matched,
+        "span_sum_worst_err_s": round(worst, 6),
+        "slo": table,
+        "burn_gauge_exported": "crane_slo_burn_rate" in text,
+        "latency_hist_exported": "crane_job_latency_seconds" in text,
+        "ok": bool(
+            not ledger["lost"] and not ledger["doubled"]
+            and checked == len(ids) and matched == checked
+            and "crane_slo_burn_rate" in text
+            and "crane_job_latency_seconds" in text),
+    }
+    return out
+
+
 def replay_fifo(scale: float, rng, run=_run_direct):
     """BASELINE config #1: FIFO 10k jobs x 1k nodes (cpu+mem)."""
     from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
@@ -333,10 +413,18 @@ def main(argv=None) -> int:
                          "gRPC -> WAL -> cycle -> dispatch")
     ap.add_argument("--wal", default="",
                     help="WAL path for --rpc (empty = no WAL)")
+    ap.add_argument("--slo", action="store_true",
+                    help="closed-loop mode: drive --rpc, then assert "
+                         "the SLO/ledger contract from the run's own "
+                         "exported telemetry")
     args = ap.parse_args(argv)
 
     run = _run_direct
-    if args.rpc:
+    if args.slo:
+        import functools
+        run = functools.partial(_run_closed_loop,
+                                wal_path=args.wal or None)
+    elif args.rpc:
         import functools
         run = functools.partial(_run_rpc, wal_path=args.wal or None)
 

@@ -176,7 +176,8 @@ class CtldClient:
                            node_id: int = -1, incarnation: int = 0,
                            step_id: int | None = None,
                            cpu_seconds: float = 0.0,
-                           max_rss_bytes: int = 0) -> pb.OkReply:
+                           max_rss_bytes: int = 0,
+                           spans=()) -> pb.OkReply:
         req = pb.StepStatusChangeRequest(job_id=job_id, status=status,
                                          exit_code=exit_code, time=time,
                                          node_id=node_id,
@@ -185,6 +186,12 @@ class CtldClient:
                                          max_rss_bytes=max_rss_bytes)
         if step_id is not None:
             req.step_id = step_id
+        # craned-side lifecycle spans (obs/jobtrace.py ship-back)
+        for s in spans or ():
+            req.spans.append(pb.JobSpan(
+                edge=s["edge"], seq=int(s["seq"]), time=float(s["t"]),
+                node_id=int(s.get("node_id", -1)),
+                skew=float(s.get("skew", 0.0))))
         return self._call("StepStatusChange", req, pb.OkReply)
 
     # ---- steps within an allocation ----
@@ -226,11 +233,15 @@ class CtldClient:
         return self._call("RequeueJob", pb.JobIdRequest(job_id=job_id),
                           pb.OkReply)
 
-    def query_job_summary(self, user: str = "", partition: str = ""
+    def query_job_summary(self, user: str = "", partition: str = "",
+                          job_id: int = 0
                           ) -> pb.QueryJobSummaryReply:
+        """job_id != 0 additionally returns that job's timeline as
+        JSON (standby-servable, like the summary itself)."""
         return self._call(
             "QueryJobSummary",
-            pb.QueryJobSummaryRequest(user=user, partition=partition),
+            pb.QueryJobSummaryRequest(user=user, partition=partition,
+                                      job_id=job_id),
             pb.QueryJobSummaryReply)
 
     def ha_status(self) -> pb.HaStatusReply:

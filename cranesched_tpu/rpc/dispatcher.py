@@ -34,11 +34,16 @@ from cranesched_tpu.rpc.stub import GrpcStub
 class _CranedStub(GrpcStub):
     """One channel per craned (reference CranedStub)."""
 
+    #: duck-typed capability flag — the dispatcher only passes the
+    #: crane-trace metadata kwarg to stubs that advertise it (test
+    #: fakes keep the plain (name, request) signature)
+    trace_metadata = True
+
     def __init__(self, address: str, timeout: float = 10.0, tls=None):
         super().__init__(address, CRANED_SERVICE, timeout, tls=tls)
 
-    def call(self, name, request, reply_cls=pb.OkReply):
-        return super().call(name, request, reply_cls)
+    def call(self, name, request, reply_cls=pb.OkReply, metadata=()):
+        return super().call(name, request, reply_cls, metadata=metadata)
 
 
 class _PushState:
@@ -205,6 +210,17 @@ class GrpcDispatcher:
         tasks = job.task_layout or [1] * len(node_ids)
         gang = self._gang_ctx(job.job_id, node_ids,
                               int(sum(tasks[: len(node_ids)])))
+        # trace context (jobtrace): the base span seq lets the craned
+        # number its local spans after the ctld-side ones, so the merged
+        # timeline sorts monotonically by seq.  Captured here, at build
+        # time, right after the ring drain stamped committed_durable +
+        # dispatched for this incarnation.
+        trace_md = ()
+        if getattr(self.scheduler, "jobtrace", None) is not None:
+            base_seq = self.scheduler.trace_seq(job.job_id, incarnation)
+            trace_md = (("crane-trace",
+                         f"{job.job_id}/{incarnation}/{epoch}/"
+                         f"{base_seq}"),)
 
         def push(node_id, ntasks):
             stub = self._stub(node_id)
@@ -227,7 +243,12 @@ class GrpcDispatcher:
                 if step_pb is not None:
                     req.step.CopyFrom(step_pb)
                 try:
-                    reply = stub.call(verb, req)
+                    if trace_md and getattr(stub, "trace_metadata",
+                                            False):
+                        reply = stub.call(verb, req,
+                                          metadata=trace_md)
+                    else:
+                        reply = stub.call(verb, req)
                 except grpc.RpcError as exc:
                     return f"push to node {node_id} failed: {exc.code()}"
                 if reply.ok:

@@ -558,6 +558,14 @@ class CtldServer:
             # RPC and can flag "scheduler stalled" client-side
             doc["metrics"] = REGISTRY.snapshot()
             doc["cycle_trace"] = self.scheduler.cycle_trace.snapshot()
+            # per-job tracing + SLO plane (cstats --slo): evaluating on
+            # query refreshes the burn-rate gauges, so /metrics scraped
+            # right after a cstats --slo shows the same numbers
+            if self.scheduler.jobtrace is not None:
+                doc["jobtrace"] = self.scheduler.jobtrace.stats()
+            if self.scheduler.slo_engine is not None:
+                doc["slo"] = self.scheduler.slo_engine.evaluate(
+                    time.time())
             topo = getattr(self.scheduler.meta, "topology", None)
             if topo is not None:
                 from cranesched_tpu.topo.model import topology_doc
@@ -834,6 +842,12 @@ class CtldServer:
         if deny:
             return pb.OkReply(ok=False, error=deny)
         with self._lock:
+            if request.spans:
+                # craned-side lifecycle spans land BEFORE the status
+                # change is queued, so the timeline holds them when the
+                # next cycle stamps the terminal ``end`` edge
+                self.scheduler.record_remote_spans(
+                    request.job_id, request.incarnation, request.spans)
             if request.HasField("step_id"):
                 # step-level report (real craneds): routes through the
                 # per-step machine; batch step 0 closes the job
@@ -880,12 +894,21 @@ class CtldServer:
 
     def QueryJobSummary(self, request, context):
         """Per-status counts (reference QueryJobSummary,
-        Crane.proto:1588) — works on a standby too (shadow state)."""
+        Crane.proto:1588) — works on a standby too (shadow state).
+        job_id != 0 additionally returns that job's recorded timeline
+        (followers serve the traces they replicated, read-only)."""
         self._require_authenticated(self._ident(context), context)
+        timeline = ""
         with self._lock:
             counts = self.scheduler.job_summary(request.user,
                                                 request.partition)
-        reply = pb.QueryJobSummaryReply(total=sum(counts.values()))
+            if request.job_id and self.scheduler.jobtrace is not None:
+                doc = self.scheduler.jobtrace.timeline(request.job_id)
+                if doc is not None:
+                    import json as _json
+                    timeline = _json.dumps(doc)
+        reply = pb.QueryJobSummaryReply(total=sum(counts.values()),
+                                        timeline_json=timeline)
         for status in sorted(counts):
             reply.states.add(status=status, count=counts[status])
         return reply

@@ -29,7 +29,10 @@ class GrpcStub:
             self._channel = grpc.insecure_channel(address)
         self._stubs = {}
 
-    def call(self, name, request, reply_cls, timeout: float | None = None):
+    def call(self, name, request, reply_cls, timeout: float | None = None,
+             metadata=()):
+        """``metadata``: extra (key, value) pairs appended after the
+        auth token — e.g. the dispatcher's crane-trace context."""
         stub = self._stubs.get(name)
         if stub is None:
             stub = self._channel.unary_unary(
@@ -37,10 +40,10 @@ class GrpcStub:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=reply_cls.FromString)
             self._stubs[name] = stub
-        metadata = (((self.token_key, self.token),) if self.token
-                    else None)
+        md = (((self.token_key, self.token),) if self.token else ())
+        md = md + tuple(metadata)
         return stub(request, timeout=timeout or self.timeout,
-                    metadata=metadata)
+                    metadata=md or None)
 
     # server streams drain large result sets across many scheduler
     # cycles — the unary timeout (30 s) would abort them mid-stream

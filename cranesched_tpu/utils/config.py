@@ -26,6 +26,33 @@ def parse_mem(value) -> int:
     return int(text)
 
 
+def _parse_onoff(value) -> bool:
+    """YAML on/off/true/false (the reference uses "on"/"off" strings
+    for feature switches; PyYAML already maps on->True, but keep the
+    string forms working for hand-built dicts)."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("on", "true", "yes", "1")
+    return bool(value)
+
+
+def _parse_slo(entries) -> tuple:
+    """``Observability: SLO:`` list -> SchedulerConfig.slo tuples
+    (name, from, to, p, target_seconds, windows) — the SloSpec.as_tuple
+    shape obs/slo.py consumes."""
+    out = []
+    for e in entries or ():
+        if isinstance(e, dict):
+            frm, to = str(e["from"]), str(e["to"])
+            out.append((
+                str(e.get("name", f"{frm}-to-{to}")), frm, to,
+                float(e.get("p", 99)), float(e["target_seconds"]),
+                tuple(float(w) for w in e.get("windows",
+                                              (60, 300, 3600)))))
+        else:
+            out.append(tuple(e))
+    return tuple(out)
+
+
 def parse_max_age(value) -> int:
     """Reference PriorityMaxAge formats (CraneCtld.cpp:327-364):
     "day-hour", "hour:minute:second", "minute", plain seconds."""
@@ -207,7 +234,14 @@ class CraneConfig:
             # S-stream Pallas solve knobs; pin from the measured optimum
             # in profiles/<device>_STREAMS_PROFILE.md (tools/kstream.py)
             max_streams=int(sc.get("MaxStreams", 4)),
-            block_jobs=int(sc.get("BlockJobs", 256)))
+            block_jobs=int(sc.get("BlockJobs", 256)),
+            # per-job lifecycle tracing (obs/jobtrace.py) + SLO targets
+            # (obs/slo.py) from the Observability: block
+            job_trace=_parse_onoff(
+                self.observability.get("JobTrace", True)),
+            job_trace_capacity=int(
+                self.observability.get("JobTraceCapacity", 4096)),
+            slo=_parse_slo(self.observability.get("SLO")))
         hook = None
         if self.submit_hook_path:
             hook = load_submit_hook(self.submit_hook_path)

@@ -1,0 +1,349 @@
+"""Per-job distributed tracing (obs/jobtrace.py + obs/slo.py): timeline
+completeness across submit/hold/requeue/preempt/HA-recovery paths, gRPC
+trace-context propagation ctld->craned, SLO window math, and the
+bounded-memory spill contract.  Lane: -m jobtrace (make tier1-trace)."""
+
+import json
+import time
+
+import pytest
+
+from cranesched_tpu.craned.sim import SimCluster
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    JobStatus,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.obs.jobtrace import (
+    SPAN_EDGES,
+    JobTraceRecorder,
+    render_waterfall,
+)
+from cranesched_tpu.obs.slo import SloEngine, SloSpec
+
+pytestmark = pytest.mark.jobtrace
+
+
+def build(num_nodes=2, wal=None, **cfg):
+    meta = MetaContainer()
+    for i in range(num_nodes):
+        meta.add_node(f"cn{i:02d}",
+                      meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                         memsw_bytes=16 << 30,
+                                         is_capacity=True))
+        meta.craned_up(i)
+    sched = JobScheduler(meta, SchedulerConfig(**cfg), wal=wal)
+    sim = SimCluster(sched)
+    sim.wire(sched)
+    return sched, sim
+
+
+def spec(cpu=1.0, runtime=50.0, **kw):
+    return JobSpec(res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime, **kw)
+
+
+def edges_of(doc, incarnation=0):
+    inc = [i for i in doc["incarnations"]
+           if i["incarnation"] == incarnation][0]
+    return [s["edge"] for s in inc["spans"]]
+
+
+# ---------------- timeline completeness ----------------
+
+
+def test_happy_path_records_every_edge_in_order():
+    sched, sim = build()
+    j = sched.submit(spec(runtime=30.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sim.advance_to(35.0)
+    sched.schedule_cycle(now=35.0)
+
+    doc = sched.jobtrace.timeline(j)
+    got = edges_of(doc)
+    want = [e for e in SPAN_EDGES if e != "requeue"]
+    assert got == want
+    # seq strictly monotone within the incarnation
+    seqs = [s["seq"] for s in doc["incarnations"][0]["spans"]]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert doc["incarnations"][0]["closed"]
+    # span times never go backwards
+    ts = [s["t"] for s in doc["incarnations"][0]["spans"]]
+    assert ts == sorted(ts)
+
+
+def test_held_job_has_no_eligible_span_until_release():
+    sched, sim = build()
+    j = sched.submit(spec(), now=0.0)
+    sched.hold(j, held=True, now=0.0)
+    sched.schedule_cycle(now=1.0)
+    doc = sched.jobtrace.timeline(j)
+    assert edges_of(doc) == ["submit"], "held jobs are not eligible"
+    sched.hold(j, held=False, now=2.0)
+    sched.schedule_cycle(now=3.0)
+    assert "eligible" in edges_of(sched.jobtrace.timeline(j))
+
+
+def test_requeue_closes_incarnation_and_opens_next():
+    sched, sim = build()
+    j = sched.submit(spec(runtime=500.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    assert sched.requeue(j, now=5.0) == ""
+    sched.schedule_cycle(now=6.0)
+    sim.advance_to(600.0)
+    sched.schedule_cycle(now=600.0)
+
+    doc = sched.jobtrace.timeline(j)
+    incs = {i["incarnation"]: i for i in doc["incarnations"]}
+    assert set(incs) == {0, 1}
+    assert edges_of(doc, 0)[-1] == "requeue"
+    assert edges_of(doc, 1)[-1] == "end"
+    # exactly one terminal end across all incarnations (ledger clean)
+    ledger = sched.jobtrace.ledger([j])
+    assert ledger["lost"] == [] and ledger["doubled"] == []
+
+
+def test_preempted_victim_timeline_closes_with_requeue():
+    from cranesched_tpu.ctld import PendingReason
+    from cranesched_tpu.ctld.accounting import (
+        Account, AccountManager, AdminLevel, Qos, User)
+    mgr = AccountManager()
+    mgr.users["root"] = User(name="root", admin_level=AdminLevel.ROOT)
+    mgr.add_qos("root", Qos(name="low", priority=0))
+    mgr.add_qos("root", Qos(name="high", priority=1000,
+                            preempt={"low"}))
+    mgr.add_account("root", Account(name="hpc",
+                                    allowed_qos={"low", "high"},
+                                    default_qos="low"))
+    mgr.add_user("root", User(name="alice", uid=1), "hpc")
+    meta = MetaContainer()
+    meta.add_node("cn00", meta.layout.encode(cpu=8, mem_bytes=16 << 30,
+                                             memsw_bytes=16 << 30,
+                                             is_capacity=True))
+    meta.craned_up(0)
+    sched = JobScheduler(meta, SchedulerConfig(preempt_mode="requeue"),
+                         accounts=mgr)
+    sim = SimCluster(sched)
+    sim.wire(sched)
+
+    def qspec(qos, cpu):
+        return JobSpec(user="alice", account="hpc", qos=qos,
+                       res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                        memsw_bytes=1 << 30),
+                       sim_runtime=100000.0, time_limit=100000.0)
+
+    victim = sched.submit(qspec("low", 8.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    preemptor = sched.submit(qspec("high", 8.0), now=1.0)
+    sched.schedule_cycle(now=1.0)
+    assert sched.job_info(victim).pending_reason == \
+        PendingReason.PREEMPTED
+    doc = sched.jobtrace.timeline(victim)
+    assert edges_of(doc, 0)[-1] == "requeue"
+    assert "placed" in edges_of(sched.jobtrace.timeline(preemptor))
+
+
+def test_recovery_seeds_without_dropping_or_doubling(tmp_path):
+    """The HA completeness contract: a scheduler rebuilt from the WAL
+    (the promoted-standby shape) seeds synthetic timelines for every
+    replayed job — and re-seeding over spans that already exist is a
+    no-op (stamp-once), so nothing drops and nothing double-counts."""
+    path = str(tmp_path / "ctld.wal")
+    wal = WriteAheadLog(path)
+    sched, sim = build(wal=wal)
+    done = sched.submit(spec(runtime=5.0), now=0.0)
+    running = sched.submit(spec(runtime=500.0), now=0.0)
+    pend = sched.submit(spec(cpu=8.0, runtime=10.0), now=0.0)
+    pend2 = sched.submit(spec(cpu=8.0, runtime=10.0), now=0.0)
+    sched.schedule_cycle(now=0.0)
+    sim.advance_to(6.0)
+    sched.process_status_changes()
+    assert sched.job_info(done).status == JobStatus.COMPLETED
+    wal.close()
+
+    sched2, sim2 = build()
+    sched2.recover(WriteAheadLog.replay(path), now=7.0)
+    ledger = sched2.jobtrace.ledger([done])
+    assert ledger["lost"] == [] and ledger["doubled"] == []
+    # re-adopted running job: synthetic spans through dispatched
+    doc = sched2.jobtrace.timeline(running)
+    assert edges_of(doc)[:2] == ["submit", "eligible"]
+    assert all(s.get("synthetic") for s in doc["incarnations"][0]["spans"])
+    stamps_before = sched2.jobtrace.stamps_total
+    # double-promotion / replayed seeding must not double-stamp
+    sched2.jobtrace.seed_recovered(sched2.job_info(running), 8.0)
+    assert sched2.jobtrace.stamps_total == stamps_before
+    # the recovered plane finishes the job with a REAL end span: the
+    # adopted craned (whose event queue did not die with the old ctld)
+    # reports completion straight into the new incumbent
+    sched2.step_status_change(running, JobStatus.COMPLETED, 0, 500.0,
+                              incarnation=0)
+    sched2.schedule_cycle(now=600.0)
+    ledger = sched2.jobtrace.ledger([done, running])
+    assert ledger["lost"] == [] and ledger["doubled"] == []
+
+
+# ---------------- gRPC context propagation ----------------
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_grpc_propagation_craned_spans_merge(tmp_path):
+    """Real plane: the dispatch push carries crane-trace metadata, the
+    craned stamps its local edges re-based on the ctld clock, and the
+    final StepStatusChange ships them back into the same timeline."""
+    from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
+    from cranesched_tpu.rpc import serve
+    from cranesched_tpu.rpc.dispatcher import GrpcDispatcher
+
+    meta = MetaContainer()
+    sched = JobScheduler(meta, SchedulerConfig(backfill=False,
+                                               craned_timeout=3.0))
+    dispatcher = GrpcDispatcher(sched)
+    dispatcher.wire(sched)
+    server, port = serve(sched, cycle_interval=0.15,
+                         dispatcher=dispatcher)
+    d = CranedDaemon("tr00", f"127.0.0.1:{port}", cpu=4.0,
+                     mem_bytes=4 << 30, workdir=str(tmp_path),
+                     ping_interval=0.5,
+                     cgroup_root=str(tmp_path / "nocgroup"))
+    d.start()
+    try:
+        assert wait_for(lambda: d.state == CranedState.READY)
+        jid = sched.submit(JobSpec(res=ResourceSpec(cpu=1.0),
+                                   script="true"), now=time.time())
+        assert wait_for(
+            lambda: (sched.job_info(jid) or None) is not None
+            and sched.job_info(jid).status == JobStatus.COMPLETED)
+        assert wait_for(lambda: "step_start" in edges_of(
+            sched.jobtrace.timeline(jid) or {"incarnations": [
+                {"incarnation": 0, "spans": []}]}))
+        sim_doc = sched.jobtrace.timeline(jid)
+        got = edges_of(sim_doc)
+        for edge in ("submit", "eligible", "placed",
+                     "committed_durable", "dispatched",
+                     "craned_received", "cgroup_ready", "step_start"):
+            assert edge in got, f"missing {edge}: {got}"
+        spans = {s["edge"]: s
+                 for s in sim_doc["incarnations"][0]["spans"]}
+        # remote spans carry the node id and kept their propagated seq
+        # ordering after the ctld-side spans
+        assert spans["craned_received"]["node_id"] >= 0
+        assert (spans["craned_received"]["seq"]
+                > spans["dispatched"]["seq"])
+        assert (spans["step_start"]["seq"]
+                > spans["cgroup_ready"]["seq"]
+                > spans["craned_received"]["seq"])
+        # re-based times stay monotone within the skew bound
+        skew = max(s.get("skew", 0.0) for s in spans.values())
+        assert (spans["craned_received"]["t"]
+                >= spans["dispatched"]["t"] - max(skew, 0.5))
+        # the timeline rides QueryJobSummary (cstats --job path)
+        from cranesched_tpu.rpc.client import make_client
+        client = make_client(f"127.0.0.1:{port}")
+        reply = client.query_job_summary(job_id=jid)
+        doc = json.loads(reply.timeline_json)
+        assert doc["job_id"] == jid
+        assert render_waterfall(doc), "waterfall must render"
+        client.close()
+    finally:
+        d.stop()
+        dispatcher.close()
+        server.stop()
+
+
+# ---------------- SLO window math ----------------
+
+
+def test_slo_percentile_and_burn_rate_math():
+    eng = SloEngine([SloSpec("s2d", "submit", "dispatched", p=90.0,
+                             target=1.0, windows=(100.0,))])
+    # 10 observations at t=50: latencies 0.1..0.9 plus one 5.0 outlier
+    for i, lat in enumerate([0.1 * k for k in range(1, 10)] + [5.0]):
+        eng.record("dispatched", {"submit": 50.0 - lat}, 50.0)
+    table = eng.evaluate(50.0)
+    w = table[0]["windows"]["100"]
+    assert w["count"] == 10
+    # p90 over 10 sorted samples -> index min(9, 9) = the outlier
+    assert w["observed"] == pytest.approx(5.0)
+    # 1 of 10 over target / allowed 0.1 -> burn exactly 1.0 (breach)
+    assert w["burn_rate"] == pytest.approx(1.0)
+    assert w["breaching"]
+
+    # the window slides: at t=200 every sample expired
+    table = eng.evaluate(200.0)
+    w = table[0]["windows"]["100"]
+    assert w["count"] == 0 and w["burn_rate"] == 0.0
+    assert not w["breaching"]
+
+
+def test_slo_breach_counter_is_edge_triggered():
+    from cranesched_tpu.obs.slo import _MET_BREACH
+    eng = SloEngine([SloSpec("edge", "a", "b", p=50.0, target=0.5,
+                             windows=(1000.0,))])
+    base = _MET_BREACH.value(slo="edge")
+    eng.record("b", {"a": 0.0}, 10.0)   # latency 10 > 0.5: breach
+    eng.evaluate(10.0)
+    eng.evaluate(11.0)
+    eng.evaluate(12.0)
+    after = _MET_BREACH.value(slo="edge")
+    assert after - base == 1, "sustained breach counts once"
+
+
+def test_slo_measures_within_one_incarnation_only():
+    """A requeued job's new incarnation measures from ITS submit span,
+    never across incarnations (the span_times dict is per-timeline)."""
+    slo = SloEngine([SloSpec("s2e", "submit", "end", p=50.0,
+                             target=100.0, windows=(10000.0,))])
+    rec = JobTraceRecorder(slo=slo)
+    rec.stamp(1, 0, "submit", 0.0)
+    rec.stamp(1, 0, "requeue", 5.0)
+    rec.stamp(1, 1, "submit", 6.0)
+    rec.stamp(1, 1, "end", 9.0)
+    table = slo.evaluate(9.0)
+    w = table[0]["windows"]["10000"]
+    assert w["count"] == 1
+    assert w["observed"] == pytest.approx(3.0)   # 9-6, not 9-0
+
+
+# ---------------- bounded memory ----------------
+
+
+def test_ring_spill_is_bounded_and_counted():
+    rec = JobTraceRecorder(capacity=8)
+    for j in range(50):
+        rec.stamp(j, 0, "submit", float(j))
+    stats = rec.stats()
+    assert stats["active"] <= 8
+    assert stats["spilled"] == 50 - 8
+    # closed timelines spill from their own ring of the same capacity
+    for j in range(100, 150):
+        rec.stamp(j, 0, "submit", float(j))
+        rec.stamp(j, 0, "end", float(j) + 1.0)
+    stats = rec.stats()
+    assert stats["completed"] <= 8
+    # evicted-then-restamped edges open a FRESH timeline (no KeyError,
+    # no resurrection): the spill is lossy and says so
+    assert rec.stamp(0, 0, "submit", 999.0) in (True, False)
+
+
+def test_stamp_once_is_idempotent_per_incarnation():
+    rec = JobTraceRecorder()
+    assert rec.stamp(7, 0, "submit", 1.0) is True
+    assert rec.stamp(7, 0, "submit", 2.0) is False, "duplicate edge"
+    assert rec.stamp(7, 1, "submit", 3.0) is True, "new incarnation"
+    doc = rec.timeline(7)
+    assert len(doc["incarnations"]) == 2
+    assert doc["incarnations"][0]["spans"][0]["t"] == 1.0

@@ -144,6 +144,22 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
         ("error", 6, F.TYPE_STRING),
     ])
 
+    # per-job tracing (obs/jobtrace.py): craned-side lifecycle spans
+    # ship back inside StepStatusChange; timelines ride QueryJobSummary
+    n += _add_message(fd, "JobSpan", [
+        ("edge", 1, F.TYPE_STRING),
+        ("seq", 2, F.TYPE_UINT32),
+        ("time", 3, F.TYPE_DOUBLE),
+        ("node_id", 4, F.TYPE_INT32),
+        ("skew", 5, F.TYPE_DOUBLE),
+    ])
+    n += _add_field(_msg(fd, "StepStatusChangeRequest"), "spans", 10,
+                    F.TYPE_MESSAGE, LABEL_REP, ".cranesched.JobSpan")
+    n += _add_field(_msg(fd, "QueryJobSummaryRequest"), "job_id", 3,
+                    F.TYPE_UINT32)
+    n += _add_field(_msg(fd, "QueryJobSummaryReply"), "timeline_json", 3,
+                    F.TYPE_STRING)
+
     # new CraneCtld methods (hand-glued handlers key off _RPCS, but the
     # descriptor stays the wire contract of record)
     n += _add_rpc(fd, "CraneCtld", "RequeueJob", "JobIdRequest",

@@ -71,6 +71,13 @@ assert rs["placements_match"], (
 assert ("host_to_device_bytes_per_cycle" in sc
         and "patch_overlap_share" in sc), (
     f"sched_cycle detail lost the resident pipeline fields: {sc}")
+# job-trace overhead guard: the per-job timeline recorder stamps every
+# lifecycle edge inside the cycle — it must cost <=2% of churn cycle
+# wall time (measured trace-on vs trace-off on the same seed)
+tg = ch["tracing"]
+assert tg["trace_overhead_share"] <= 0.02, (
+    f"job tracing added {tg['trace_overhead_share']:.1%} to the churn "
+    f"cycle (limit 2%): {tg}")
 print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"lock_held_share={lock_share:.3f} "
       f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
@@ -78,5 +85,6 @@ print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"idle_tick_share={ch['idle_tick_share']} "
       f"resident_h2d_bytes={rs['h2d_bytes_per_cycle']} "
       f"patch_overlap_share={rs['patch_overlap_share']} "
+      f"trace_overhead_share={tg['trace_overhead_share']} "
       f"solver={sc['solver']}")
 PY
