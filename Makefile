@@ -32,6 +32,10 @@
 #              submit/hold/requeue/preempt/HA-failover, gRPC trace
 #              propagation ctld→craned, SLO window/burn math, and the
 #              bounded-ring spill accounting.
+# tier1-lint — metrics/docs parity (tools/check_metrics_docs.py):
+#              every registered crane_* metric has a row in the
+#              ARCHITECTURE.md metric inventory table and vice-versa.
+#              Runs first under `make tier1`.
 # tier1-resident — device-resident cluster-state lane
 #              (@pytest.mark.resident in tests/test_resident_state.py):
 #              steady-state patch (no full [N,R] rebuild), donation
@@ -41,10 +45,15 @@
 #              path.
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
-	tier1-delta tier1-resident tier1-trace
+	tier1-delta tier1-resident tier1-trace tier1-lint
 
-tier1:
+tier1: tier1-lint
 	bash tools/tier1.sh
+
+# metrics/docs parity lint: every registered crane_* metric must have a
+# row in the ARCHITECTURE.md metric inventory table and vice-versa
+tier1-lint:
+	python tools/check_metrics_docs.py
 
 tier1-obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \

@@ -27,7 +27,9 @@ BASELINE_DECISIONS_PER_SEC = 100_000.0
 # TPU-probe budget: ONE bounded subprocess attempt (an earlier version
 # retried until the deadline, so a hanging tunnel charged the timeout
 # several times over before the CPU fallback ran)
-DEFAULT_DEVICE_TIMEOUT_S = 240.0
+# raised from 240 (BENCH_r08): the r07 TPU probe timed out mid-init;
+# give the runtime's one-time device bring-up a comfortable budget
+DEFAULT_DEVICE_TIMEOUT_S = 420.0
 
 
 def _devices_with_timeout(timeout_s: float) -> dict:
@@ -155,7 +157,9 @@ def _measure_sched_cycle(num_jobs: int, num_nodes: int) -> dict:
         sched.wal.close()
     out = {k: trace[k] for k in ("solver", "prelude_ms", "solve_ms",
                                  "commit_ms", "dispatch_ms", "total_ms",
-                                 "num_streams", "wal_groups")
+                                 "num_streams", "wal_groups",
+                                 "recompiles", "device_bytes",
+                                 "device_peak_bytes", "device_buffers")
            if k in trace}
     out["jobs"] = num_jobs
     out["nodes"] = num_nodes
@@ -281,7 +285,9 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         k = max(int(len(sched.pending) * churn), 1)
         preludes, totals, dirty = [], [], []
         h2d_bytes, h2d_rows, dirty_nodes, modes = [], [], [], []
-        trace_ms = []
+        trace_ms, recompiles = [], []
+        from cranesched_tpu.obs import introspect
+        introspect_s0 = introspect.self_time_s()
         now = 3.0
         for _ in range(cycles):
             pend_ids = list(sched.pending.keys())
@@ -303,7 +309,9 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
             h2d_rows.append(int(tr.get("h2d_rows") or 0))
             dirty_nodes.append(int(tr.get("dirty_nodes") or 0))
             modes.append(tr.get("resident", "off"))
+            recompiles.append(int(tr.get("recompiles") or 0))
             now += 1.0
+        introspect_ms = (introspect.self_time_s() - introspect_s0) * 1e3
         # idle tick: the last cycle placed nothing, so the fingerprint
         # is armed on the incremental path; the next no-event cycle
         # should short-circuit before building anything
@@ -330,6 +338,8 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
                                - skipped0),
             "trace_ms": round(float(np.median(trace_ms)), 4)
             if trace_ms else 0.0,
+            "recompiles": recompiles,
+            "introspect_ms": round(introspect_ms, 4),
         }
 
     inc = run(True)
@@ -352,6 +362,22 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
     }
     tracing["overhead_ok"] = bool(
         tracing["trace_overhead_share"] <= 0.02)
+    # introspection-plane leg (ISSUE 14): warm churn cycles must pay
+    # ZERO fresh jit compiles (the bucketed-padding contract, now
+    # measured rather than assumed), and the observer probes + device
+    # memory sampling must cost <= 2% of the cycle.  Same direct
+    # self-time measurement as the tracing leg, same jitter rationale.
+    steady_ms = max(inc["total_ms"] * cycles, 1e-9)
+    introspection = {
+        "recompiles_per_cycle": inc["recompiles"],
+        "zero_steady_recompiles": bool(
+            all(r == 0 for r in inc["recompiles"])),
+        "introspect_ms_total": inc["introspect_ms"],
+        "introspect_overhead_share": round(
+            inc["introspect_ms"] / steady_ms, 4),
+    }
+    introspection["overhead_ok"] = bool(
+        introspection["introspect_overhead_share"] <= 0.02)
     # resident-state acceptance legs (ISSUE 11): same seed/event stream
     # on the device scan solver, resident patching vs per-cycle rebuild
     res_on = run(True, solver="device", resident=True)
@@ -392,6 +418,7 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         "cycles": cycles,
         "incremental": inc, "full_rebuild": base,
         "resident": resident, "tracing": tracing,
+        "introspection": introspection,
         # same seed + same event stream: identical first-wave placement
         # is the in-bench parity check (the real oracle lives in
         # tests/test_delta_cycle.py)

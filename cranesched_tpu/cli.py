@@ -754,9 +754,12 @@ def cmd_cstats(args) -> int:
         print(_fmt_table(rows, ("SLO", "EDGE", "TARGET", "WINDOW",
                                 "COUNT", "OBSERVED", "BURN", "STATE")))
         return 0
-    if getattr(args, "metrics", False):
+    prefix = getattr(args, "metrics", None)
+    if prefix is not None:
         rows = []
         for name, m in sorted((doc.get("metrics") or {}).items()):
+            if not name.startswith(prefix):
+                continue
             for labels, v in sorted(m.get("values", {}).items()):
                 if isinstance(v, dict):   # histogram series
                     val = (f"count={v.get('count')} "
@@ -764,9 +767,75 @@ def cmd_cstats(args) -> int:
                 else:
                     val = v
                 rows.append((name + labels, m.get("type"), val))
+        if not rows and prefix:
+            print(f"no metric family starts with {prefix!r}",
+                  file=sys.stderr)
+            return 1
         print(_fmt_table(rows, ("METRIC", "TYPE", "VALUE")))
         return 0
     print(_json.dumps(doc))
+    return 0
+
+
+def cmd_cevents(args) -> int:
+    """Structured cluster-event ring (standby-servable): node flaps,
+    fencing rejections, watchdog crashes, failovers, SLO breaches,
+    preemptions, requeues, steady-state recompiles."""
+    client = _client(args)
+    reply = client.query_events(severity=args.severity,
+                                since=args.since,
+                                after_seq=args.after,
+                                limit=args.limit,
+                                type=args.type)
+    if not reply.events:
+        print("no matching events", file=sys.stderr)
+        return 1
+    rows = [(e.seq, f"{e.time:.3f}", e.severity.upper(), e.type,
+             e.node or "-", e.job_id or "-", e.detail or "-")
+            for e in reply.events]
+    print(_fmt_table(rows, ("SEQ", "TIME", "SEV", "TYPE", "NODE",
+                            "JOB", "DETAIL")))
+    return 0
+
+
+def cmd_cexplain(args) -> int:
+    """Why is this job not running?  First-failing-gate decomposition
+    of the scheduler's feasibility pipeline for one pending job."""
+    import json as _json
+    client = _client(args)
+    reply = client.query_job_summary(job_id=args.job_id)
+    if not reply.explain_json:
+        print(f"no explanation for job {args.job_id}", file=sys.stderr)
+        return 1
+    doc = _json.loads(reply.explain_json)
+    if args.json:
+        print(_json.dumps(doc, indent=2))
+        return 0
+    head = f"job {doc['job_id']}"
+    if doc.get("state"):
+        head += f" [{doc['state']}]"
+    if doc.get("reason"):
+        head += f" pending_reason={doc['reason']}"
+    print(head)
+    print(f"  blocked at: {doc.get('gate') or '-'}"
+          + (f" — {doc['detail']}" if doc.get("detail") else ""))
+    checks = doc.get("checks") or ()
+    if checks:
+        rows = [("PASS" if c["ok"] else ">>>", c["gate"],
+                 c.get("detail") or "-") for c in checks]
+        print(_fmt_table(rows, ("", "GATE", "DETAIL")))
+    return 0
+
+
+def cmd_cprofile(args) -> int:
+    """Arm an on-demand jax.profiler capture spanning the next N
+    scheduling cycles; the trace lands under profiles/ on the leader."""
+    client = _client(args)
+    reply = client.capture_profile(cycles=args.cycles, dir=args.dir)
+    if not reply.ok:
+        print(f"cprofile: {reply.error}", file=sys.stderr)
+        return 1
+    print(f"profiling armed for {args.cycles} cycle(s) -> {reply.dir}")
     return 0
 
 
@@ -1179,8 +1248,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("cstats", help="scheduler cycle statistics")
     p.add_argument("--cycles", action="store_true",
                    help="print the last-N cycle trace ring as a table")
-    p.add_argument("--metrics", action="store_true",
-                   help="print the metric registry snapshot as a table")
+    p.add_argument("--metrics", nargs="?", const="", default=None,
+                   metavar="PREFIX",
+                   help="print the metric registry snapshot as a table; "
+                        "optional PREFIX keeps only metric families "
+                        "whose name starts with it")
     p.add_argument("--ha", action="store_true",
                    help="print HA role / fencing epoch / replication "
                         "lag as a table")
@@ -1191,6 +1263,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the live SLO table (per-window "
                         "percentile + burn rate)")
     p.set_defaults(func=cmd_cstats)
+
+    p = sub.add_parser("cevents",
+                       help="structured cluster events (flaps, fencing, "
+                            "breaches, ...)")
+    p.add_argument("--severity", "-s", default="",
+                   choices=["", "debug", "info", "warning", "error",
+                            "critical"],
+                   help="minimum severity to show")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="only events at/after this epoch time")
+    p.add_argument("--after", type=int, default=0, metavar="SEQ",
+                   help="only events with seq > SEQ (cursor)")
+    p.add_argument("--type", "-t", default="",
+                   help="exact event type (e.g. node_flap, slo_breach)")
+    p.add_argument("--limit", "-L", type=int, default=0,
+                   help="newest N matches (0 = all)")
+    p.set_defaults(func=cmd_cevents)
+
+    p = sub.add_parser("cexplain",
+                       help="why is this job pending? first failing "
+                            "feasibility gate")
+    p.add_argument("job_id", type=int)
+    p.add_argument("--json", action="store_true",
+                   help="print the raw decomposition document")
+    p.set_defaults(func=cmd_cexplain)
+
+    p = sub.add_parser("cprofile",
+                       help="capture a jax.profiler trace of the next "
+                            "N scheduling cycles")
+    p.add_argument("--cycles", "-n", type=int, default=3)
+    p.add_argument("--dir", default="",
+                   help="output directory (default profiles/capture-*)")
+    p.set_defaults(func=cmd_cprofile)
 
     p = sub.add_parser("crequeue",
                        help="stop running jobs and requeue them")

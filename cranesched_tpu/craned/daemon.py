@@ -65,6 +65,9 @@ _MET_SPAWN = _OBS.histogram(
 _MET_CGROUP = _OBS.histogram(
     "crane_cgroup_op_seconds",
     "cgroup create/destroy wall time (label op)")
+_MET_FENCED = _OBS.counter(
+    "crane_craned_fenced_total",
+    "pushed orders refused by the fencing-epoch latch")
 
 
 class _Alloc:
@@ -285,6 +288,7 @@ class CranedDaemon:
             if epoch > self._fencing_epoch:
                 self._fencing_epoch = epoch
             elif epoch < self._fencing_epoch:
+                _MET_FENCED.inc()
                 return (f"fenced: request epoch {epoch} < "
                         f"latched {self._fencing_epoch}")
         return ""

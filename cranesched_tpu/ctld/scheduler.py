@@ -80,6 +80,8 @@ from cranesched_tpu.models.solver_time import (
     solve_backfill,
 )
 from cranesched_tpu.obs import REGISTRY as _OBS
+from cranesched_tpu.obs import introspect
+from cranesched_tpu.obs.events import EventLog
 from cranesched_tpu.obs.jobtrace import JobTraceRecorder
 from cranesched_tpu.obs.slo import SloEngine
 from cranesched_tpu.obs.trace import CycleTraceRing, solve_span
@@ -596,6 +598,20 @@ class JobScheduler:
         self.jobtrace = (JobTraceRecorder(
             capacity=config.job_trace_capacity, slo=self.slo_engine)
             if config.job_trace else None)
+        # structured cluster event log (obs/events.py): this ctld emits
+        # locally; a follower additionally ingests the leader's events
+        # via the HaFetchWal piggyback, so cevents works on standbys
+        self.events = EventLog()
+        if self.slo_engine is not None:
+            self.slo_engine.event_sink = self._slo_event
+        # introspection plane (obs/introspect.py): per-cycle recompile
+        # attribution is delta-based off the process-wide counter; the
+        # profiler window is armed by the CaptureProfile RPC and ticked
+        # at cycle boundaries
+        self._cycle_compile_base = introspect.total_compiles()
+        self.profiler_window = introspect.ProfilerWindow(
+            event_sink=lambda type, sev, detail="": self.events.emit(
+                type, sev, detail=detail))
         # the in-flight cycle's ``now``: the dispatch-ring drain runs
         # lock-released and stamps committed_durable/dispatched on the
         # same clock the cycle used (virtual in sims, wall in daemons)
@@ -625,6 +641,9 @@ class JobScheduler:
         self.node_events.append(record)
         if len(self.node_events) > 200:
             del self.node_events[: len(self.node_events) - 200]
+        # mirror into the typed event ring (flap detection included)
+        self.events.emit_node_transition(event, node_name, detail=detail,
+                                         now=now)
         if self.node_event_hook is None:
             return
         if self._node_event_queue is None:
@@ -650,10 +669,33 @@ class JobScheduler:
             threading.Thread(target=worker, daemon=True).start()
         self._node_event_queue.put(record)
 
+    def _slo_event(self, name: str, window: float, burn: float,
+                   breaching: bool) -> None:
+        """SloEngine breach-edge sink -> typed event ring."""
+        if breaching:
+            self.events.emit(
+                "slo_breach", "error",
+                detail="%s window=%ds burn=%.2f" % (name, window, burn))
+        else:
+            self.events.emit(
+                "slo_clear",
+                detail="%s window=%ds recovered" % (name, window))
+
+    def explain_pending(self, job_id: int, now: float) -> dict:
+        """First-failing-gate decomposition for one job (``cexplain``).
+        Caller holds the server lock."""
+        from cranesched_tpu.ctld.explain import explain_pending
+        return explain_pending(self, job_id, now)
+
     # history the RAM dict may hold with an archive attached (the
     # durable store serves the rest; without an archive RAM is the only
     # record and must not be evicted)
     HISTORY_CACHE_MAX = 10_000
+
+    # cycles before a fresh jit compile counts as a steady-state
+    # violation (the first cycles after boot/failover legitimately
+    # populate the cache for each padded-shape bucket)
+    WARMUP_CYCLES = 3
 
     def attach_archive(self, archive) -> None:
         """Wire the durable history store (also used by ctld_main after
@@ -1165,6 +1207,8 @@ class JobScheduler:
             job.held = True
             job.pending_reason = PendingReason.HELD
         self.pending[job_id] = job
+        self.events.emit("requeue", job_id=job_id, detail="operator",
+                         time=now)
         if self.wal is not None:
             self.wal.job_requeued(job)
         return ""
@@ -1995,6 +2039,8 @@ class JobScheduler:
                 job.held = True
                 job.pending_reason = PendingReason.HELD
             self.pending[job_id] = job
+            self.events.emit("requeue", job_id=job_id,
+                             detail="node down", time=now)
             if self.wal is not None:
                 self.wal.job_requeued(job)
         return victim_ids
@@ -2096,6 +2142,10 @@ class JobScheduler:
         wal = self.wal
         self._wal_cycle_base = ((wal.fsync_total, wal.groups_total)
                                 if wal is not None else (0, 0))
+        # introspection: per-cycle recompile attribution + the armed
+        # profiler capture window tick (cheap no-ops when idle)
+        self._cycle_compile_base = introspect.total_compiles()
+        self.profiler_window.tick()
         self._wal_begin()
         try:
             started = yield from self._cycle_body(now)
@@ -2707,6 +2757,24 @@ class JobScheduler:
             _MET_H2D.inc(res.last_h2d_bytes, mode=res_mode)
             _MET_RESIDENT.inc(mode=res_mode)
             _MET_OVERLAP.set(res.overlap_share())
+        # introspection plane: recompiles paid by THIS cycle (delta off
+        # the process-wide observer) + device-memory gauges.  A warm
+        # cycle paying a fresh compile breaks the bucketed-padding
+        # contract — surface it as an event, not just a counter.
+        recompiles = (introspect.total_compiles()
+                      - getattr(self, "_cycle_compile_base", 0))
+        mem = introspect.sample_device_memory()
+        trace.update(
+            recompiles=recompiles,
+            device_bytes=mem["bytes"],
+            device_peak_bytes=mem["peak_bytes"],
+            device_buffers=mem["buffers"],
+        )
+        if recompiles > 0 and self.stats["cycles"] >= self.WARMUP_CYCLES:
+            self.events.emit(
+                "recompile_steady", "warning",
+                detail="cycle %d paid %d recompile(s)" % (
+                    self.stats["cycles"], recompiles))
         self._in_cycle = False
         self.cycle_trace.push(trace)
         self._skip_trace = None
@@ -3314,6 +3382,9 @@ class JobScheduler:
         _MET_PREEMPTED.inc()
         self._cur_trace["preempted"] = (
             self._cur_trace.get("preempted", 0) + 1)
+        self.events.emit("preemption", "warning", job_id=victim_id,
+                         detail="mode=%s" % self.config.preempt_mode,
+                         time=now)
         if victim.spec.alloc_only:
             self.dispatch_free_alloc(victim_id, now,
                                      incarnation=victim.requeue_count)

@@ -188,8 +188,10 @@ class HaFollower(threading.Thread):
         try:
             if not self._have_snapshot:
                 self._pull_snapshot()
-            rep = self._dial().ha_fetch_wal(self.applied_seq,
-                                            limit=self.fetch_limit)
+            events = self.scheduler.events
+            rep = self._dial().ha_fetch_wal(
+                self.applied_seq, limit=self.fetch_limit,
+                after_event_seq=events.remote_seq)
             if rep.resync:
                 log.warning("cursor %d fell off the leader's tail; "
                             "resyncing from snapshot", self.applied_seq)
@@ -198,6 +200,15 @@ class HaFollower(threading.Thread):
             if not rep.ok:
                 raise RuntimeError(rep.error or "fetch refused")
             self._apply_records(rep.records)
+            # event-ring piggyback: advisory, best-effort, never blocks
+            # WAL replication
+            for ev in rep.events:
+                events.ingest({"seq": ev.seq, "time": ev.time,
+                               "type": ev.type, "severity": ev.severity,
+                               "node": ev.node, "job_id": ev.job_id,
+                               "detail": ev.detail})
+            if rep.event_seq > events.remote_seq:
+                events.remote_seq = int(rep.event_seq)
             self.lease.epoch_store.observe(rep.fencing_epoch)
             self.leader_seq = int(rep.wal_seq)
             _ha.LAG_GAUGE.set(max(0, self.leader_seq - self.applied_seq))

@@ -59,6 +59,7 @@ from cranesched_tpu.models.solver import (
     REASON_NONE,
     REASON_RESOURCE,
 )
+from cranesched_tpu.obs.introspect import instrument_jit as _instrument_jit
 from cranesched_tpu.ops.resources import DIM_CPU
 
 # node axis is folded to (SUB, N/SUB) so every vector op fills all 8
@@ -347,11 +348,13 @@ def _solve_serial_impl(state: ClusterState, req, node_num, time_limit,
 # for it; parity tests and bench repeats re-solve from the same state
 # and must keep the non-donating twin.
 _SERIAL_STATICS = ("max_nodes", "block_jobs", "interpret")
-_solve_serial_jit = functools.partial(
-    jax.jit, static_argnames=_SERIAL_STATICS)(_solve_serial_impl)
-_solve_serial_donate = functools.partial(
-    jax.jit, static_argnames=_SERIAL_STATICS,
-    donate_argnums=(0,))(_solve_serial_impl)
+_solve_serial_jit = _instrument_jit(
+    "solve_pallas_serial", functools.partial(
+        jax.jit, static_argnames=_SERIAL_STATICS)(_solve_serial_impl))
+_solve_serial_donate = _instrument_jit(
+    "solve_pallas_serial_donating", functools.partial(
+        jax.jit, static_argnames=_SERIAL_STATICS,
+        donate_argnums=(0,))(_solve_serial_impl))
 
 
 def solve_greedy_pallas(state: ClusterState, req, node_num, time_limit,
@@ -430,11 +433,13 @@ def _solve_streamed_impl(state: ClusterState, req, node_num, time_limit,
 
 _STREAM_STATICS = ("max_nodes", "block_jobs", "num_streams",
                    "stream_len", "interpret")
-_solve_streamed_jit = functools.partial(
-    jax.jit, static_argnames=_STREAM_STATICS)(_solve_streamed_impl)
-_solve_streamed_donate = functools.partial(
-    jax.jit, static_argnames=_STREAM_STATICS,
-    donate_argnums=(0,))(_solve_streamed_impl)
+_solve_streamed_jit = _instrument_jit(
+    "solve_pallas_streamed", functools.partial(
+        jax.jit, static_argnames=_STREAM_STATICS)(_solve_streamed_impl))
+_solve_streamed_donate = _instrument_jit(
+    "solve_pallas_streamed_donating", functools.partial(
+        jax.jit, static_argnames=_STREAM_STATICS,
+        donate_argnums=(0,))(_solve_streamed_impl))
 
 
 def _solve_streamed(state, req, node_num, time_limit, valid, job_class,

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from cranesched_tpu.obs.introspect import instrument_jit
 from cranesched_tpu.ops.resources import DIM_CPU
 
 # The node-cost ledger is int32 fixed point: unit = 1/COST_SCALE
@@ -261,6 +262,10 @@ def _patch_cluster_state(state: ClusterState, dirty_idx, avail_rows,
         cost=cost)
 
 
+_patch_cluster_state = instrument_jit("patch_cluster_state",
+                                      _patch_cluster_state)
+
+
 def patch_cluster_state(state: ClusterState, dirty_idx, avail_rows,
                         total_rows, alive_rows, cost) -> ClusterState:
     """Scatter-patch a device-resident ClusterState in place: overwrite
@@ -286,6 +291,9 @@ def patch_cluster_state(state: ClusterState, dirty_idx, avail_rows,
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _refresh_cost(state: ClusterState, cost) -> ClusterState:
     return state.replace(cost=cost)
+
+
+_refresh_cost = instrument_jit("refresh_cost", _refresh_cost)
 
 
 def refresh_cost_ledger(state: ClusterState, cost) -> ClusterState:
@@ -396,15 +404,20 @@ def solve_greedy(state: ClusterState, jobs: JobBatch,
     return Placements(placed=placed, nodes=nodes, reason=reason), new_state
 
 
+solve_greedy = instrument_jit("solve_greedy", solve_greedy)
+
+
 # Donating twin of solve_greedy for the device-resident cycle pipeline:
 # the input ClusterState's buffers are donated so XLA writes avail/cost
 # updates into them in place (zero-copy across cycle iterations on TPU;
 # CPU ignores donation).  After calling this the input state is dead —
 # ctld/resident.py enforces that by surrendering ownership on acquire()
 # and re-adopting only the returned state.
-_solve_greedy_donating = functools.partial(
-    jax.jit, static_argnames=("max_nodes",),
-    donate_argnums=(0,))(solve_greedy.__wrapped__)
+_solve_greedy_donating = instrument_jit(
+    "solve_greedy_donating",
+    functools.partial(
+        jax.jit, static_argnames=("max_nodes",),
+        donate_argnums=(0,))(solve_greedy.__wrapped__))
 
 
 def solve_greedy_donating(state: ClusterState, jobs: JobBatch,

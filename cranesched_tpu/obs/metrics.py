@@ -50,9 +50,22 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label_value(v: str) -> str:
+    """Exposition-format label escaping: backslash first, then quote
+    and newline (text format 0.0.4 spec)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP line escaping: backslash and newline only (quotes are legal
+    in HELP text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _labels_str(labels: tuple[tuple[str, str], ...],
                 extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -148,7 +161,7 @@ class Counter:
             return self._values.get(key, 0.0)
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} {self.kind}"]
         for key, v in sorted(self._values.items()):
             out.append(f"{self.name}{_labels_str(key)} {_fmt(v)}")
@@ -210,7 +223,7 @@ class Histogram:
             acc[1] += value
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} {self.kind}"]
         for key, (counts, (n, s)) in sorted(self._series.items()):
             cum = 0
@@ -264,11 +277,19 @@ class MetricsRegistry:
         return self._get(Histogram, name, help, buckets=buckets)
 
     def expose(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.  Each family's
+        ``# HELP``/``# TYPE`` header is emitted exactly once (guarded
+        here so a future aliased registration can't duplicate it —
+        promtool treats a second TYPE line for a family as a parse
+        error)."""
         with self._lock:
             metrics = sorted(self._metrics.items())
         lines: list[str] = []
-        for _, m in metrics:
+        seen: set[str] = set()
+        for name, m in metrics:
+            if name in seen:
+                continue
+            seen.add(name)
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 

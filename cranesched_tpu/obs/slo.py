@@ -74,6 +74,10 @@ class SloEngine:
         self.specs: list[SloSpec] = [
             s if isinstance(s, SloSpec) else SloSpec(*s)
             for s in specs]
+        # optional callable(slo_name, window_s, burn, breaching) fired
+        # on breach EDGES (start and clear) — the scheduler points this
+        # at its event log; called outside the engine lock
+        self.event_sink = None
         self._lock = threading.Lock()
         # per spec: deque of (t, latency)
         self._samples: list[deque] = [deque() for _ in self.specs]
@@ -131,6 +135,7 @@ class SloEngine:
         """Prune, compute per-window percentile + burn rate, update the
         gauges/breach counter, and return the live table."""
         table = []
+        edges: list[tuple] = []  # (name, window, burn, breaching)
         with self._lock:
             for i, spec in enumerate(self.specs):
                 dq = self._samples[i]
@@ -155,8 +160,10 @@ class SloEngine:
                     key = (spec.name, w)
                     was = self._burning.get(key, False)
                     breaching = n > 0 and burn >= 1.0
-                    if breaching and not was:
-                        _MET_BREACH.inc(slo=spec.name)
+                    if breaching != was:
+                        if breaching:
+                            _MET_BREACH.inc(slo=spec.name)
+                        edges.append((spec.name, w, burn, breaching))
                     self._burning[key] = breaching
                     _MET_BURN.set(burn, slo=spec.name, window=int(w))
                     row["windows"][str(int(w))] = {
@@ -165,6 +172,12 @@ class SloEngine:
                         "burn_rate": round(burn, 4),
                         "breaching": breaching}
                 table.append(row)
+        if self.event_sink is not None:
+            for name, w, burn, breaching in edges:
+                try:
+                    self.event_sink(name, w, burn, breaching)
+                except Exception:
+                    pass  # observability must never break evaluation
         return table
 
     def table(self, now: float) -> list[dict]:

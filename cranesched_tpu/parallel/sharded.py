@@ -48,6 +48,7 @@ from cranesched_tpu.models.solver import (
     decide_job,
     job_feasibility,
 )
+from cranesched_tpu.obs.introspect import instrument_jit as _instrument_jit
 
 NODE_AXIS = "nodes"
 
@@ -172,6 +173,10 @@ def solve_greedy_sharded(state: ClusterState, jobs: JobBatch, mesh: Mesh,
 
     new_state = state.replace(avail=avail, cost=cost)
     return Placements(placed=placed, nodes=nodes, reason=reason), new_state
+
+
+solve_greedy_sharded = _instrument_jit("solve_greedy_sharded",
+                                       solve_greedy_sharded)
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mesh",
@@ -305,6 +310,10 @@ def _solve_sharded_streamed(state: ClusterState, req, node_num,
     new_state = state.replace(avail=avail, cost=cost)
     return (Placements(placed=placed_j, nodes=nodes_j, reason=reason_j),
             new_state)
+
+
+_solve_sharded_streamed = _instrument_jit("solve_sharded_streamed",
+                                          _solve_sharded_streamed)
 
 
 def solve_greedy_sharded_classes(state: ClusterState, req, node_num,

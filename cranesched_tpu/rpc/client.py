@@ -252,12 +252,32 @@ class CtldClient:
         return self._call("HaFetchSnapshot", pb.HaSnapshotRequest(),
                           pb.HaSnapshotReply)
 
-    def ha_fetch_wal(self, after_seq: int,
-                     limit: int = 0) -> pb.HaFetchReply:
-        return self._call("HaFetchWal",
-                          pb.HaFetchRequest(after_seq=after_seq,
-                                            limit=limit),
-                          pb.HaFetchReply)
+    def ha_fetch_wal(self, after_seq: int, limit: int = 0,
+                     after_event_seq: int = 0) -> pb.HaFetchReply:
+        return self._call(
+            "HaFetchWal",
+            pb.HaFetchRequest(after_seq=after_seq, limit=limit,
+                              after_event_seq=after_event_seq),
+            pb.HaFetchReply)
+
+    def query_events(self, severity: str = "", since: float = 0.0,
+                     after_seq: int = 0, limit: int = 0,
+                     type: str = "") -> pb.QueryEventsReply:
+        """Structured cluster-event ring (standby-servable)."""
+        return self._call(
+            "QueryEvents",
+            pb.QueryEventsRequest(severity=severity, since=since,
+                                  after_seq=after_seq, limit=limit,
+                                  type=type),
+            pb.QueryEventsReply)
+
+    def capture_profile(self, cycles: int = 1,
+                        dir: str = "") -> pb.CaptureProfileReply:
+        """Arm a jax.profiler window over the next N cycles."""
+        return self._call(
+            "CaptureProfile",
+            pb.CaptureProfileRequest(cycles=cycles, dir=dir),
+            pb.CaptureProfileReply)
 
 
 # gRPC codes that mean "try the next ctld": the endpoint is down/

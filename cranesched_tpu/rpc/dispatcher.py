@@ -254,6 +254,7 @@ class GrpcDispatcher:
                 if reply.ok:
                     return ""
                 if not reply.error.startswith("retryable:"):
+                    self._note_fenced(node_id, reply.error)
                     return reply.error
                 time.sleep(0.5)
             return reply.error
@@ -352,6 +353,7 @@ class GrpcDispatcher:
                     errors.append(f"push to node {node_id}: {exc.code()}")
                     continue
                 if not reply.ok:
+                    self._note_fenced(node_id, reply.error)
                     errors.append(reply.error)
             if errors:
                 for node_id in node_ids:
@@ -469,14 +471,29 @@ class GrpcDispatcher:
         job = self.scheduler.running.get(job_id)
         return list(job.node_ids) if job is not None else []
 
+    def _note_fenced(self, node_id, error: str) -> None:
+        """Surface a craned-side fencing rejection in the event ring:
+        the craned is a separate process, so the ctld whose push was
+        refused is the one that can record it (the deposed leader's
+        ring — the test harness and post-mortems read it there)."""
+        if not error or not error.startswith("fenced"):
+            return
+        try:
+            self.scheduler.events.emit("fencing_rejection", "error",
+                                       node=str(node_id), detail=error)
+        except Exception:
+            pass  # observability must never break a dispatch path
+
     def _try_call(self, node_id, name, request) -> None:
         stub = self._stub(node_id)
         if stub is None:
             return
         try:
-            stub.call(name, request)
+            reply = stub.call(name, request)
         except grpc.RpcError:
-            pass  # the ping timeout will reap a dead node
+            return  # the ping timeout will reap a dead node
+        if not getattr(reply, "ok", True):
+            self._note_fenced(node_id, reply.error)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

@@ -898,20 +898,57 @@ class CtldServer:
         job_id != 0 additionally returns that job's recorded timeline
         (followers serve the traces they replicated, read-only)."""
         self._require_authenticated(self._ident(context), context)
-        timeline = ""
+        import json as _json
+        timeline = explain = ""
         with self._lock:
             counts = self.scheduler.job_summary(request.user,
                                                 request.partition)
-            if request.job_id and self.scheduler.jobtrace is not None:
-                doc = self.scheduler.jobtrace.timeline(request.job_id)
-                if doc is not None:
-                    import json as _json
-                    timeline = _json.dumps(doc)
+            if request.job_id:
+                if self.scheduler.jobtrace is not None:
+                    doc = self.scheduler.jobtrace.timeline(request.job_id)
+                    if doc is not None:
+                        timeline = _json.dumps(doc)
+                explain = _json.dumps(self.scheduler.explain_pending(
+                    request.job_id, self._now()))
         reply = pb.QueryJobSummaryReply(total=sum(counts.values()),
-                                        timeline_json=timeline)
+                                        timeline_json=timeline,
+                                        explain_json=explain)
         for status in sorted(counts):
             reply.states.add(status=status, count=counts[status])
         return reply
+
+    def QueryEvents(self, request, context):
+        """Structured cluster-event ring with min-severity / time /
+        cursor / type filters (``cevents``).  Standby-servable: a
+        follower answers from the events it replicated plus its own
+        local emissions (its seq numbering is local)."""
+        self._require_authenticated(self._ident(context), context)
+        with self._lock:
+            recs = self.scheduler.events.since(
+                after_seq=request.after_seq,
+                severity=request.severity,
+                since_time=request.since,
+                type=request.type,
+                limit=request.limit)
+        reply = pb.QueryEventsReply()
+        for r in recs:
+            reply.events.add(seq=r["seq"], time=r["time"],
+                             type=r["type"], severity=r["severity"],
+                             node=r["node"], job_id=r["job_id"],
+                             detail=r["detail"])
+        return reply
+
+    def CaptureProfile(self, request, context):
+        """Arm an on-demand jax.profiler window spanning the next N
+        scheduling cycles (leader-only: the trace is of the cycle loop
+        this ctld runs)."""
+        self._require_authenticated(self._ident(context), context)
+        with self._lock:
+            ok, detail = self.scheduler.profiler_window.request(
+                request.cycles or 1, out_dir=request.dir)
+        if ok:
+            return pb.CaptureProfileReply(ok=True, dir=detail)
+        return pb.CaptureProfileReply(ok=False, error=detail)
 
     def HaStatus(self, request, context):
         self._require_authenticated(self._ident(context), context)
@@ -957,8 +994,18 @@ class CtldServer:
             # the durability barrier — inside an open group `seq` does
             seq = wal.durable_seq
             epoch = self.scheduler.fencing_epoch
+            # event-ring piggyback: the ring is bounded and events are
+            # advisory, so no resync protocol — a follower that missed
+            # evicted entries just starts from what is still in the ring
+            events = self.scheduler.events.since(
+                after_seq=request.after_event_seq)
+            event_seq = self.scheduler.events.last_seq
         reply = pb.HaFetchReply(ok=True, wal_seq=seq,
-                                fencing_epoch=epoch)
+                                fencing_epoch=epoch, event_seq=event_seq)
+        for r in events:
+            reply.events.add(seq=r["seq"], time=r["time"], type=r["type"],
+                             severity=r["severity"], node=r["node"],
+                             job_id=r["job_id"], detail=r["detail"])
         if out is None:
             reply.resync = True
         else:
@@ -974,6 +1021,10 @@ class CtldServer:
         self.ha_role = "leader"
         self.ha_follower = None
         self.failovers += 1
+        self.scheduler.events.emit(
+            "failover", "critical",
+            detail="standby promoted to leader (epoch %d)" % epoch,
+            time=self._now())
         # seed push channels from the replicated node addresses so a
         # re-sent kill (recover's cancel-intent redelivery) can land
         # BEFORE the craneds get around to re-registering
@@ -1018,6 +1069,9 @@ class CtldServer:
         "HaStatus": (pb.HaStatusRequest, pb.HaStatusReply),
         "HaFetchSnapshot": (pb.HaSnapshotRequest, pb.HaSnapshotReply),
         "HaFetchWal": (pb.HaFetchRequest, pb.HaFetchReply),
+        "QueryEvents": (pb.QueryEventsRequest, pb.QueryEventsReply),
+        "CaptureProfile": (pb.CaptureProfileRequest,
+                           pb.CaptureProfileReply),
     }
 
     # the surface a standby may serve from its shadow state; everything
@@ -1028,6 +1082,7 @@ class CtldServer:
     _STANDBY_OK = frozenset({
         "QueryJobsInfo", "QueryJobsStream", "QueryStepsInfo",
         "QueryClusterInfo", "QueryStats", "QueryJobSummary", "HaStatus",
+        "QueryEvents",
     })
 
     def _now(self) -> float:
@@ -1188,6 +1243,9 @@ class CtldServer:
             st["cycle_crashes_total"] = (
                 st.get("cycle_crashes_total", 0) + 1)
             st["last_crash"] = {"time": now, "traceback": tb}
+            self.scheduler.events.emit(
+                "watchdog_crash", "error", time=now,
+                detail=tb.strip().rsplit("\n", 1)[-1][:200])
 
     def stop(self) -> None:
         self._stop.set()

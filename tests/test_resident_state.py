@@ -273,6 +273,7 @@ def test_acquire_transfers_ownership():
     sched.schedule_cycle(now=1.0)
     res = sched._resident
     assert res._state is not None
+    before = res._state  # keep alive: a freed state's id() can be reused
     issued = res.last_issued_id
     avail, total, alive = sched.meta.snapshot()
     cost0 = np.zeros(len(sched.meta.nodes), np.int32)
@@ -284,6 +285,7 @@ def test_acquire_transfers_ownership():
     assert mode == "patch"
     assert res.last_issued_id == id(state)
     assert res.last_issued_id != issued
+    assert state is not before
     res.adopt(state)
     assert res._state is state
 

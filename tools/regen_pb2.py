@@ -160,6 +160,48 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
     n += _add_field(_msg(fd, "QueryJobSummaryReply"), "timeline_json", 3,
                     F.TYPE_STRING)
 
+    # introspection plane (obs/events.py, obs/introspect.py): typed
+    # cluster events, served leader or follower, replicated by
+    # piggybacking on HaFetchWal; on-demand jax.profiler windows;
+    # pending-reason explain rides QueryJobSummary as JSON
+    n += _add_message(fd, "ClusterEvent", [
+        ("seq", 1, F.TYPE_UINT64),
+        ("time", 2, F.TYPE_DOUBLE),
+        ("type", 3, F.TYPE_STRING),
+        ("severity", 4, F.TYPE_STRING),
+        ("node", 5, F.TYPE_STRING),
+        ("job_id", 6, F.TYPE_UINT64),
+        ("detail", 7, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "QueryEventsRequest", [
+        ("severity", 1, F.TYPE_STRING),
+        ("since", 2, F.TYPE_DOUBLE),
+        ("after_seq", 3, F.TYPE_UINT64),
+        ("limit", 4, F.TYPE_UINT32),
+        ("type", 5, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "QueryEventsReply", [
+        ("events", 1, F.TYPE_MESSAGE, LABEL_REP,
+         ".cranesched.ClusterEvent"),
+    ])
+    n += _add_message(fd, "CaptureProfileRequest", [
+        ("cycles", 1, F.TYPE_UINT32),
+        ("dir", 2, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "CaptureProfileReply", [
+        ("ok", 1, F.TYPE_BOOL),
+        ("error", 2, F.TYPE_STRING),
+        ("dir", 3, F.TYPE_STRING),
+    ])
+    n += _add_field(_msg(fd, "HaFetchRequest"), "after_event_seq", 3,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "HaFetchReply"), "events", 7,
+                    F.TYPE_MESSAGE, LABEL_REP, ".cranesched.ClusterEvent")
+    n += _add_field(_msg(fd, "HaFetchReply"), "event_seq", 8,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "QueryJobSummaryReply"), "explain_json", 4,
+                    F.TYPE_STRING)
+
     # new CraneCtld methods (hand-glued handlers key off _RPCS, but the
     # descriptor stays the wire contract of record)
     n += _add_rpc(fd, "CraneCtld", "RequeueJob", "JobIdRequest",
@@ -172,6 +214,10 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
                   "HaSnapshotReply")
     n += _add_rpc(fd, "CraneCtld", "HaFetchWal", "HaFetchRequest",
                   "HaFetchReply")
+    n += _add_rpc(fd, "CraneCtld", "QueryEvents", "QueryEventsRequest",
+                  "QueryEventsReply")
+    n += _add_rpc(fd, "CraneCtld", "CaptureProfile",
+                  "CaptureProfileRequest", "CaptureProfileReply")
     return n
 
 

@@ -78,6 +78,19 @@ tg = ch["tracing"]
 assert tg["trace_overhead_share"] <= 0.02, (
     f"job tracing added {tg['trace_overhead_share']:.1%} to the churn "
     f"cycle (limit 2%): {tg}")
+# introspection-plane guards (ISSUE 14): warm churn cycles must pay
+# ZERO fresh jit compiles (the bucketed-padding zero-recompile
+# contract, now measured per cycle via the compile observer), and the
+# observer probes + device-memory sampling must cost <=2% of the cycle
+ig = ch["introspection"]
+assert ig["zero_steady_recompiles"], (
+    f"steady-state churn cycles paid fresh jit compiles "
+    f"(recompiles per cycle {ig['recompiles_per_cycle']}): {ig}")
+assert ig["introspect_overhead_share"] <= 0.02, (
+    f"introspection plane cost {ig['introspect_overhead_share']:.1%} "
+    f"of the churn cycle (limit 2%): {ig}")
+assert "recompiles" in sc and "device_buffers" in sc, (
+    f"sched_cycle detail lost the introspection fields: {sc}")
 print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"lock_held_share={lock_share:.3f} "
       f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
@@ -86,5 +99,7 @@ print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"resident_h2d_bytes={rs['h2d_bytes_per_cycle']} "
       f"patch_overlap_share={rs['patch_overlap_share']} "
       f"trace_overhead_share={tg['trace_overhead_share']} "
+      f"introspect_share={ig['introspect_overhead_share']} "
+      f"recompiles={ig['recompiles_per_cycle']} "
       f"solver={sc['solver']}")
 PY
