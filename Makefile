@@ -32,6 +32,11 @@
 #              submit/hold/requeue/preempt/HA-failover, gRPC trace
 #              propagation ctld→craned, SLO window/burn math, and the
 #              bounded-ring spill accounting.
+# tier1-fed  — federated control-plane lane (@pytest.mark.fed in
+#              tests/test_federation.py): shard-map routing + misrouted
+#              submit forwarding, the arbiter's two-phase gang commit
+#              under a mid-reserve shard crash, bounded-staleness read
+#              refusal, and bit-exact single-vs-federated parity.
 # tier1-lint — metrics/docs parity (tools/check_metrics_docs.py):
 #              every registered crane_* metric has a row in the
 #              ARCHITECTURE.md metric inventory table and vice-versa.
@@ -45,7 +50,7 @@
 #              path.
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
-	tier1-delta tier1-resident tier1-trace tier1-lint
+	tier1-delta tier1-resident tier1-trace tier1-fed tier1-lint
 
 tier1: tier1-lint
 	bash tools/tier1.sh
@@ -86,4 +91,8 @@ tier1-resident:
 
 tier1-trace:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m jobtrace \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-fed:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fed \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -518,6 +518,267 @@ def _measure_topology(num_jobs: int = 256, num_nodes: int = 512,
     }
 
 
+# one controller shard in its own PROCESS: the federated submit-
+# throughput comparison must measure real parallelism, and in-process
+# shards would share one GIL.  The script serves a full shard (sim node
+# plane + background cycles, so queries run against a concurrent solve)
+# and prints READY when bound.
+_SHARD_SERVER_SRC = r"""
+import json, sys, time
+cfg = json.loads(sys.argv[1])
+from cranesched_tpu.craned.sim import SimCluster
+from cranesched_tpu.ctld import JobScheduler, MetaContainer, \
+    SchedulerConfig
+from cranesched_tpu.fed.shardmap import ShardMap
+from cranesched_tpu.rpc.server import serve
+meta = MetaContainer()
+nid = 0
+for part in sorted(cfg["partitions"]):
+    for i in range(cfg["partitions"][part]):
+        meta.add_node("%s-%s-n%04d" % (cfg["name"], part, i),
+                      meta.layout.encode(cpu=16.0, mem_bytes=64 << 30,
+                                         memsw_bytes=64 << 30,
+                                         is_capacity=True),
+                      partitions=(part,))
+        meta.craned_up(nid)
+        nid += 1
+sched = JobScheduler(meta, SchedulerConfig(backfill=False))
+sim = SimCluster(sched)
+sim.wire(sched)
+# boot-time jit warmup: pre-trace the priority model for every queue
+# bucket the storm will cross, so no XLA compile ever runs under the
+# server lock mid-measurement (see JobScheduler.warm_jit_buckets)
+sched.warm_jit_buckets(cfg.get("warm_pending", 8192),
+                       max_running=16 * nid)
+shard_map = (ShardMap.from_doc(cfg["shards"])
+             if cfg.get("shards") else None)
+server, port = serve(sched, sim=sim,
+                     address="127.0.0.1:%d" % cfg["port"],
+                     cycle_interval=cfg.get("cycle_interval", 0.05),
+                     shard_name=cfg["name"], shard_map=shard_map)
+print("READY", port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+# query-latency measurer in its OWN process: inside the storming bench
+# process the reader thread shares the GIL with protobuf-serializing
+# submit threads, which inflates measured latency ~100x with artifacts
+# that are the bench client's, not the server's.  Runs until a line
+# arrives on stdin, then prints the sample list as JSON.
+_QUERY_CLIENT_SRC = r"""
+import json, sys, threading, time
+from cranesched_tpu.rpc.client import CtldClient
+cli = CtldClient(sys.argv[1], timeout=60.0)
+stop = threading.Event()
+threading.Thread(target=lambda: (sys.stdin.readline(), stop.set()),
+                 daemon=True).start()
+lat = []
+while not stop.is_set():
+    t0 = time.perf_counter()
+    cli.query_job_summary()
+    lat.append((time.perf_counter() - t0) * 1e3)
+print(json.dumps(lat), flush=True)
+cli.close()
+"""
+
+
+def _measure_federation(n_specs: int = 4_000,
+                        nodes_per_part: int = 32) -> dict:
+    """Federated control-plane numbers (ISSUE 15): submit throughput of
+    two subprocess shards over disjoint partitions vs ONE controller
+    over the union, query p99 under the concurrent background solve,
+    and the arbiter's share of placements from the closed-loop
+    federation sim.
+
+    Method: each controller is measured IN ISOLATION (one server
+    process alive at a time, identical client concurrency and identical
+    total submitted work per scenario), and the federated figure is the
+    sum of the per-shard isolated rates.  Shards share no state and
+    deploy on separate controller hosts, so the aggregate is additive
+    by construction; running both shard processes concurrently on this
+    host would only time-slice its cores and measure the bench box, not
+    the control plane."""
+    import socket
+    import subprocess
+    import threading
+
+    from cranesched_tpu.rpc import crane_pb2 as pb
+    from cranesched_tpu.rpc.client import CtldClient
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(cfg):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SHARD_SERVER_SRC, json.dumps(cfg)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"shard {cfg['name']} died rc={proc.returncode}")
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", cfg["port"]), timeout=0.5).close()
+                return proc
+            except OSError:
+                time.sleep(0.1)
+        proc.kill()
+        raise RuntimeError(f"shard {cfg['name']} never bound")
+
+    def spec(partition):
+        return pb.JobSpec(
+            res=pb.ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                                memsw_bytes=1 << 30),
+            partition=partition, sim_runtime=5.0)
+
+    def storm(address, partitions, total_specs):
+        """Saturate ONE live controller: one submit thread per entry in
+        `partitions` (identical client concurrency in every scenario),
+        plus a dedicated query-client PROCESS measuring read latency
+        while the server is solving + absorbing writes.  A warmup wave
+        runs first so the background cycles pay their jit compiles
+        before the clock starts."""
+        per = total_specs // len(partitions)
+        walls = [0.0] * len(partitions)
+        accepted = [0] * len(partitions)
+
+        warm = CtldClient(address, timeout=60.0)
+        for _ in range(per // 250):
+            # full-volume warmup: walk the pending queue through every
+            # padding bucket the measured storm will hit
+            warm.submit_many([spec(partitions[0])] * 250)
+            time.sleep(0.4)
+        time.sleep(4.0)  # background cycles compile + settle
+        warm.close()
+
+        qp = subprocess.Popen(
+            [sys.executable, "-c", _QUERY_CLIENT_SRC, address],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        time.sleep(0.3)  # let the query client connect + start looping
+
+        def submit(i, partition):
+            cli = CtldClient(address, timeout=60.0)
+            batch = [spec(partition)] * 250
+            t0 = time.perf_counter()
+            for _ in range(per // 250):
+                replies = cli.submit_many(batch).replies
+                accepted[i] += sum(1 for r in replies if r.job_id)
+            walls[i] = time.perf_counter() - t0
+            cli.close()
+
+        threads = [threading.Thread(target=submit, args=(i, p))
+                   for i, p in enumerate(partitions)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        qp.stdin.write("stop\n")
+        qp.stdin.flush()
+        q_lat = json.loads(qp.stdout.readline() or "[]")
+        qp.wait(timeout=30)
+        total = sum(accepted)
+        wall = max(walls)
+        lat = np.asarray(q_lat) if q_lat else np.zeros(1)
+        return {
+            "jobs_accepted": total,
+            "wall_s": round(wall, 3),
+            "submits_per_s": round(total / wall, 1) if wall else 0.0,
+            "query_samples": len(q_lat),
+            "query_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "query_p99_ms": round(float(np.percentile(lat, 99)), 2),
+        }
+
+    ports = {"solo": free_port(), "east": free_port(),
+             "west": free_port()}
+    shards_doc = [
+        {"name": "east", "partitions": ["batch"],
+         "address": f"127.0.0.1:{ports['east']}", "followers": []},
+        {"name": "west", "partitions": ["gpu"],
+         "address": f"127.0.0.1:{ports['west']}", "followers": []},
+    ]
+
+    def isolated(cfg, partitions, total_specs):
+        proc = spawn(cfg)
+        try:
+            return storm(f"127.0.0.1:{cfg['port']}", partitions,
+                         total_specs)
+        finally:
+            proc.kill()
+            proc.wait()
+
+    # one controller over the union of partitions, saturated by two
+    # submit threads (one per partition)
+    single = isolated(
+        {"name": "solo", "port": ports["solo"],
+         "partitions": {"batch": nodes_per_part,
+                        "gpu": nodes_per_part}},
+        ["batch", "gpu"], n_specs)
+    # each shard alone, same two-thread saturation, half the work each
+    # (the same n_specs total lands on the federation)
+    east = isolated(
+        {"name": "east", "port": ports["east"],
+         "partitions": {"batch": nodes_per_part},
+         "shards": shards_doc},
+        ["batch", "batch"], n_specs // 2)
+    west = isolated(
+        {"name": "west", "port": ports["west"],
+         "partitions": {"gpu": nodes_per_part},
+         "shards": shards_doc},
+        ["gpu", "gpu"], n_specs // 2)
+    federated = {
+        "jobs_accepted": east["jobs_accepted"] + west["jobs_accepted"],
+        "submits_per_s": round(
+            east["submits_per_s"] + west["submits_per_s"], 1),
+        "query_p50_ms": max(east["query_p50_ms"],
+                            west["query_p50_ms"]),
+        "query_p99_ms": max(east["query_p99_ms"],
+                            west["query_p99_ms"]),
+        "per_shard": {"east": east, "west": west},
+    }
+
+    # arbiter share from the closed-loop federation sim (the same drill
+    # REPLAY_r07 records, including the mid-storm shard SIGKILL)
+    from cranesched_tpu.replay import replay_federation
+    drill = replay_federation(0.1, np.random.default_rng(0))
+    locals_finished = drill["jobs_submitted"] - drill["gangs"]
+    members = drill["jobs_finished"] - locals_finished
+    speedup = (federated["submits_per_s"]
+               / max(single["submits_per_s"], 1e-9))
+    return {
+        "specs_per_scenario": n_specs,
+        "nodes_per_partition": nodes_per_part,
+        "method": "each controller saturated in isolation (one server "
+                  "process at a time, identical client concurrency); "
+                  "federated = sum of per-shard isolated rates — "
+                  "shards share nothing and run on separate hosts",
+        "single": single,
+        "federated": federated,
+        "submit_speedup": round(speedup, 2),
+        "speedup_ge_2x": bool(speedup >= 2.0),
+        "query_p99_lt_50ms": bool(
+            federated["query_p99_ms"] < 50.0),
+        "arbiter": {
+            "gang_share_submitted": drill["gang_share"],
+            "commits": drill["gang_commits"],
+            "aborts": drill["gang_aborts"],
+            "members_placed": members,
+            "arbiter_share_of_placements": round(
+                members / max(drill["jobs_finished"], 1), 3),
+            "ledger_ok": drill["ok"],
+        },
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -532,6 +793,14 @@ def main() -> int:
         help="also run the topology scenario: gang-heavy queue with and "
              "without a generated block topology (intra-block placement "
              "rate + cycle-time delta; env BENCH_TOPOLOGY)")
+    ap.add_argument(
+        "--federation", action="store_true",
+        default=bool(os.environ.get("BENCH_FEDERATION")),
+        help="also run the federated control-plane scenario: 2-shard "
+             "subprocess submit throughput vs one controller, query "
+             "p99 under concurrent solve, and the arbiter's placement "
+             "share (env BENCH_FEDERATION; shape via BENCH_FED_SPECS/"
+             "BENCH_FED_NODES)")
     ap.add_argument(
         "--churn", action="store_true",
         default=bool(os.environ.get("BENCH_CHURN")),
@@ -793,6 +1062,20 @@ def main() -> int:
         except Exception as exc:
             topo_bench = {"error": f"{type(exc).__name__}: {exc}"}
 
+    fed_bench = None
+    if args.federation:
+        try:
+            # 32 nodes/partition keeps the storm queue-saturated like
+            # the north-star shape (jobs >> free slots); with more
+            # slots than specs every wave places instantly and the
+            # scenario measures commit churn, not scheduling ingest
+            fed_bench = _measure_federation(
+                n_specs=int(os.environ.get("BENCH_FED_SPECS", 4_000)),
+                nodes_per_part=int(os.environ.get("BENCH_FED_NODES",
+                                                  32)))
+        except Exception as exc:
+            fed_bench = {"error": f"{type(exc).__name__}: {exc}"}
+
     churn_bench = None
     if args.churn:
         try:
@@ -822,6 +1105,7 @@ def main() -> int:
             "commit": commit_bench,
             "topology": topo_bench,
             "churn": churn_bench,
+            "federation": fed_bench,
             "device": str(dev), "repeats": repeats,
             "device_acquisition": acquisition,
         },

@@ -536,8 +536,60 @@ def cmd_crun(args) -> int:
         cfored.stop()
 
 
+def _fed_flags(p) -> None:
+    """Bounded-staleness + fan-out flags shared by every read verb."""
+    p.add_argument("--max-staleness", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="bounded-staleness read: a follower older than "
+                        "this many seconds refuses and the query falls "
+                        "through to the leader (0 = any replica)")
+    p.add_argument("--federation", action="store_true",
+                   help="fan the query out to every shard and label "
+                        "rows with their shard of origin")
+
+
+def _fed_connect(args):
+    """Build the scatter-gather client for --federation commands, or
+    None (with a diagnostic) when the cluster has no shard map."""
+    from cranesched_tpu.fed.query import FederatedClient
+    fed = FederatedClient.connect(args.server, token=_token(args),
+                                  tls=_tls(args))
+    if fed is None:
+        print("not a federated cluster (QueryShardMap returned no "
+              "shards)", file=sys.stderr)
+    return fed
+
+
+def _fed_footer(res) -> None:
+    """Per-shard provenance lines: which replica answered (and how
+    durable its view was), and which shards failed to answer."""
+    for shard, reply in res:
+        seq = getattr(reply, "durable_seq", 0)
+        print(f"# shard {shard}: durable_seq={seq}")
+    for shard, err in sorted(res.errors.items()):
+        print(f"# shard {shard}: UNAVAILABLE ({err})", file=sys.stderr)
+
+
 def cmd_cqueue(args) -> int:
     from cranesched_tpu.rpc.client import StreamResult
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.jobs(max_staleness=args.max_staleness,
+                       user=args.user, partition=args.partition,
+                       include_history=args.history, limit=args.limit,
+                       after_job_id=args.after)
+        rows = [(shard, j.job_id, j.name[:20], j.user, j.partition,
+                 j.status, j.pending_reason or "-",
+                 ",".join(j.node_names) or "-")
+                for shard, reply in res for j in reply.jobs]
+        print(_fmt_table(rows, ("SHARD", "JOBID", "NAME", "USER",
+                                "PARTITION", "STATE", "REASON",
+                                "NODES")))
+        _fed_footer(res)
+        fed.close()
+        return 1 if res.errors else 0
     client = _client(args)
     rows = []
     res = StreamResult()
@@ -546,7 +598,8 @@ def cmd_cqueue(args) -> int:
     for j in client.query_jobs_stream(
             user=args.user, partition=args.partition,
             include_history=args.history, limit=args.limit,
-            after_job_id=args.after, result=res):
+            after_job_id=args.after, result=res,
+            max_staleness=args.max_staleness):
         rows.append((j.job_id, j.name[:20], j.user, j.partition,
                      j.status, j.pending_reason or "-",
                      ",".join(j.node_names) or "-"))
@@ -600,10 +653,25 @@ def _cinfo_topo(client) -> int:
 
 
 def cmd_cinfo(args) -> int:
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.cluster(max_staleness=args.max_staleness)
+        rows = [(shard, n.name, ",".join(n.partitions), n.state,
+                 f"{n.cpu_avail:g}/{n.cpu_total:g}",
+                 f"{n.mem_avail >> 30}G/{n.mem_total >> 30}G",
+                 n.running_jobs)
+                for shard, reply in res for n in reply.nodes]
+        print(_fmt_table(rows, ("SHARD", "NODE", "PARTITIONS", "STATE",
+                                "CPU(A/T)", "MEM(A/T)", "JOBS")))
+        _fed_footer(res)
+        fed.close()
+        return 1 if res.errors else 0
     client = _client(args)
     if getattr(args, "topo", False):
         return _cinfo_topo(client)
-    reply = client.query_cluster()
+    reply = client.query_cluster(max_staleness=args.max_staleness)
     rows = []
     for n in reply.nodes:
         rows.append((n.name, ",".join(n.partitions), n.state,
@@ -643,9 +711,29 @@ def cmd_csummary(args) -> int:
     """Aggregated per-state job counts (the reference's
     QueryJobSummary, Crane.proto:1588) — one small reply instead of
     streaming the whole queue."""
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.summary(max_staleness=args.max_staleness,
+                          user=args.user, partition=args.partition)
+        counts: dict[str, int] = {}
+        total = 0
+        for _shard, reply in res:
+            total += reply.total
+            for s in reply.states:
+                counts[s.status] = counts.get(s.status, 0) + s.count
+        rows = [(st, counts[st]) for st in sorted(counts)]
+        print(_fmt_table(rows, ("STATE", "COUNT")))
+        print(f"# total {total} across "
+              f"{len(res.replies)} shard(s)")
+        _fed_footer(res)
+        fed.close()
+        return 1 if res.errors else 0
     client = _client(args)
     reply = client.query_job_summary(user=args.user,
-                                     partition=args.partition)
+                                     partition=args.partition,
+                                     max_staleness=args.max_staleness)
     rows = [(s.status, s.count) for s in reply.states]
     print(_fmt_table(rows, ("STATE", "COUNT")))
     print(f"# total {reply.total}")
@@ -681,6 +769,21 @@ def _cstats_stalled(doc) -> str | None:
 
 def cmd_cstats(args) -> int:
     import json as _json
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.stats(max_staleness=args.max_staleness)
+        doc = {shard: _json.loads(reply.json)
+               for shard, reply in res}
+        for shard, sub in doc.items():
+            sub["_durable_seq"] = getattr(
+                res.replies[shard], "durable_seq", 0)
+        for shard, err in sorted(res.errors.items()):
+            doc[shard] = {"_error": err}
+        print(_json.dumps(doc))
+        fed.close()
+        return 1 if res.errors else 0
     client = _client(args)
     if getattr(args, "job", 0):
         # the timeline rides QueryJobSummary (standby-servable) — no
@@ -694,7 +797,8 @@ def cmd_cstats(args) -> int:
         for line in render_waterfall(_json.loads(reply.timeline_json)):
             print(line)
         return 0
-    doc = _json.loads(client.query_stats().json)
+    doc = _json.loads(client.query_stats(
+        max_staleness=getattr(args, "max_staleness", 0.0)).json)
     stalled = _cstats_stalled(doc)
     if stalled:
         print(f"WARNING: {stalled}", file=sys.stderr)
@@ -781,12 +885,37 @@ def cmd_cevents(args) -> int:
     """Structured cluster-event ring (standby-servable): node flaps,
     fencing rejections, watchdog crashes, failovers, SLO breaches,
     preemptions, requeues, steady-state recompiles."""
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.events(severity=args.severity, since=args.since,
+                         after_seq=args.after, limit=args.limit,
+                         type=args.type,
+                         max_staleness=args.max_staleness)
+        rows = []
+        for shard, reply in res:
+            rows.extend(
+                (f"{e.time:.3f}", shard, e.seq, e.severity.upper(),
+                 e.type, e.node or "-", e.job_id or "-",
+                 e.detail or "-")
+                for e in reply.events)
+        rows.sort(key=lambda r: float(r[0]))
+        if rows:
+            print(_fmt_table(rows, ("TIME", "SHARD", "SEQ", "SEV",
+                                    "TYPE", "NODE", "JOB", "DETAIL")))
+        else:
+            print("no matching events", file=sys.stderr)
+        _fed_footer(res)
+        fed.close()
+        return 1 if (res.errors or not rows) else 0
     client = _client(args)
     reply = client.query_events(severity=args.severity,
                                 since=args.since,
                                 after_seq=args.after,
                                 limit=args.limit,
-                                type=args.type)
+                                type=args.type,
+                                max_staleness=args.max_staleness)
     if not reply.events:
         print("no matching events", file=sys.stderr)
         return 1
@@ -1204,12 +1333,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="page size (0 = everything)")
     p.add_argument("--after", type=int, default=0,
                    help="resume after this job id (keyset cursor)")
+    _fed_flags(p)
     p.set_defaults(func=cmd_cqueue)
 
     p = sub.add_parser("cinfo", help="show cluster nodes")
     p.add_argument("--topo", action="store_true",
                    help="render the interconnect topology tree "
                         "(blocks/switches, free nodes, fragmentation)")
+    _fed_flags(p)
     p.set_defaults(func=cmd_cinfo)
 
     p = sub.add_parser("ccancel", help="cancel jobs")
@@ -1262,6 +1393,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo", action="store_true",
                    help="print the live SLO table (per-window "
                         "percentile + burn rate)")
+    _fed_flags(p)
     p.set_defaults(func=cmd_cstats)
 
     p = sub.add_parser("cevents",
@@ -1279,6 +1411,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exact event type (e.g. node_flap, slo_breach)")
     p.add_argument("--limit", "-L", type=int, default=0,
                    help="newest N matches (0 = all)")
+    _fed_flags(p)
     p.set_defaults(func=cmd_cevents)
 
     p = sub.add_parser("cexplain",
@@ -1306,6 +1439,7 @@ def build_parser() -> argparse.ArgumentParser:
                        help="per-state job counts (cheap aggregate)")
     p.add_argument("--user", "-u", default="")
     p.add_argument("--partition", "-p", default="")
+    _fed_flags(p)
     p.set_defaults(func=cmd_csummary)
 
     p = sub.add_parser("cacctmgr", help="accounts/users/QoS admin")

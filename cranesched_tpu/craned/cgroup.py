@@ -47,17 +47,23 @@ DEFAULT_DEVICE_RULES = (
 )
 
 
-def write_pid_to_cgroup(procs_path, pid: int) -> None:
+def write_pid_to_cgroup(procs_path, pid: int) -> bool:
     """Attach ``pid`` to a job's cgroup(s): one cgroup.procs path for
     v2, a list (one per controller hierarchy) for v1.  Best-effort by
-    contract — callers run where cgroups may be absent entirely."""
+    contract — callers run where cgroups may be absent entirely.
+    Returns True when the pid landed in at least one hierarchy; False
+    means NO containment happened (no paths, or every write failed) so
+    callers can surface the gap instead of silently proceeding."""
+    attached = False
     for pp in ([procs_path] if isinstance(procs_path, str)
                else procs_path or []):
         try:
             with open(pp, "w") as fh:
                 fh.write(str(pid))
+            attached = True
         except OSError:
             pass
+    return attached
 
 
 def _kill_pids(procs_file: str) -> bool:

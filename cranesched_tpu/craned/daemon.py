@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import enum
 import json
+import logging
 import os
 import re
 import subprocess
@@ -193,6 +194,11 @@ class CranedDaemon:
         # Prometheus /metrics endpoint: None = off, 0 = ephemeral port
         self.metrics_port = metrics_port
         self._metrics_server = None
+        # node-local structured event ring (obs/events.py is per-
+        # process by design): containment drills read warnings like
+        # cgroup_adopt_fallback here instead of grepping the daemon log
+        from cranesched_tpu.obs.events import EventLog
+        self.events = EventLog(capacity=128)
         self.state = CranedState.DISCONNECTED
         self.node_id: int | None = None
         self.cgroups = make_cgroups(cgroup_root)
@@ -1277,9 +1283,21 @@ class CranedDaemon:
                 pid = int(parts[2])
             except ValueError:
                 return "DENY bad pid\n"
-            # cgroup unavailable = access still granted, containment
-            # best-effort (documented gap)
-            write_pid_to_cgroup(alloc.procs_path, pid)
+            if not write_pid_to_cgroup(alloc.procs_path, pid):
+                # cgroup unavailable = access still granted, but the
+                # best-effort fallback is no longer silent: the gap
+                # lands in the node's structured event log (and the
+                # daemon log) so containment drills can assert on it
+                self.events.emit(
+                    "cgroup_adopt_fallback", "warning",
+                    node=self.name, job_id=alloc.job_id,
+                    detail=f"pid {pid} adopted into job "
+                           f"{alloc.job_id} without cgroup "
+                           "containment (cgroupfs unavailable)")
+                logging.getLogger("cranesched.craned").warning(
+                    "PAM ADOPT: pid %d joined job %d WITHOUT cgroup "
+                    "containment (no writable cgroup.procs)",
+                    pid, alloc.job_id)
             out = [f"OK {alloc.job_id}\n"]
             for key, value in sorted(alloc.env.items()):
                 # the frame is newline-delimited: an env value carrying

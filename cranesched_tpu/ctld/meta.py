@@ -48,7 +48,7 @@ class Partition:
 # rebuilding.  last_ping/running_jobs are deliberately absent — a ping
 # must not bump the meta epoch and wake an idle scheduler.
 _SNAP_FIELDS = frozenset({"avail", "total", "alive", "drained",
-                          "health_drained", "power_state"})
+                          "health_drained", "power_state", "fed_leased"})
 
 
 @dataclasses.dataclass
@@ -81,11 +81,18 @@ class NodeMeta:
     # top-down group-name path (e.g. (switch, block)) and torus coords
     block_path: tuple = ()
     coords: tuple | None = None
+    # federation: lease id while the node is reserved for the placement
+    # arbiter's cross-partition gang solve (fed/shard.py).  Folding the
+    # flag into ``schedulable`` excludes the node from snapshots AND
+    # fails local malloc attempts for the lease's whole lifetime, so a
+    # shard-local cycle can never race the arbiter onto the same node.
+    fed_leased: str = ""
 
     @property
     def schedulable(self) -> bool:
         return (self.alive and not self.drained
                 and not self.health_drained
+                and not self.fed_leased
                 and self.power_state != "POWEREDOFF")
 
     def __setattr__(self, name, value):

@@ -625,6 +625,12 @@ class JobScheduler:
         # WAL-persisted: after a failover the preemption solve
         # re-derives any eviction still worth making.
         self._deferred_evictions: dict[int, tuple[float, int]] = {}
+        # federated control plane (fed/): this controller's shard name
+        # ("" outside a federation) and the lease plane grafted on by
+        # fed.shard.FedShardPlane.attach — None for single-controller
+        # clusters, so every fed hook is a cheap attribute check
+        self.shard_name = ""
+        self.fed = None
         if archive is not None:
             self.attach_archive(archive)
 
@@ -3617,6 +3623,61 @@ class JobScheduler:
         while b < n:
             b *= 2
         return b
+
+    def warm_jit_buckets(self, max_pending: int,
+                         max_running: int = 0) -> int:
+        """Pre-trace the jitted priority model for every padded-shape
+        bucket steady-state traffic is expected to hit.
+
+        Boot-time only, no lock needed.  Without this, the per-bucket
+        XLA compile (~0.5s on a CPU backend) fires inside the first
+        cycle whose queue crosses the bucket — in the prelude, under
+        the server lock, where it stalls every reader for the length of
+        the compile and the query-plane p99 becomes the compiler's
+        latency rather than the server's.
+
+        Warms (pending, running) bucket pairs: every pending bucket up
+        to ``max_pending`` crossed with running buckets {16,
+        bucket(max_running)} — after the first full cycle the running
+        bucket jumps straight to the cluster's slot count, so the
+        intermediate running buckets are rarely seen in steady state.
+        Returns the number of shape variants traced."""
+        if self.config.priority_type == "basic":
+            return 0  # FIFO path has no jitted priority solve
+        num_accounts = self._bucket(len(self._account_index))
+        rps = {16}
+        if max_running > 0:
+            rps.add(self._bucket(max_running))
+        jps = [16]
+        while jps[-1] < max_pending:
+            jps.append(jps[-1] * 2)
+        traced = 0
+        for rp in sorted(rps):
+            running = RunningPriorityAttrs(
+                qos_prio=jnp.zeros(rp, jnp.int32),
+                part_prio=jnp.zeros(rp, jnp.int32),
+                node_num=jnp.zeros(rp, jnp.int32),
+                cpus=jnp.zeros(rp, jnp.float32),
+                mem=jnp.zeros(rp, jnp.float32),
+                account=jnp.zeros(rp, jnp.int32),
+                run_time=jnp.zeros(rp, jnp.int32),
+                valid=jnp.zeros(rp, bool))
+            for jp in jps:
+                pending = PendingPriorityAttrs(
+                    age=jnp.zeros(jp, jnp.int32),
+                    qos_prio=jnp.zeros(jp, jnp.int32),
+                    part_prio=jnp.zeros(jp, jnp.int32),
+                    node_num=jnp.zeros(jp, jnp.int32),
+                    cpus=jnp.zeros(jp, jnp.float32),
+                    mem=jnp.zeros(jp, jnp.float32),
+                    account=jnp.zeros(jp, jnp.int32),
+                    valid=jnp.zeros(jp, bool))
+                pri = multifactor_priority(
+                    pending, running, self.config.priority_weights,
+                    num_accounts)
+                priority_order(pri).block_until_ready()
+                traced += 1
+        return traced
 
     def _mask_for(self, job: Job, now: float = 0.0) -> np.ndarray:
         if self._mask_cache_epoch != self.meta.resv_epoch:

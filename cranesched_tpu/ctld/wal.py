@@ -299,8 +299,21 @@ class WriteAheadLog:
         self._fh.close()
 
     def _append(self, event: str, job: Job) -> None:
+        self._append_rec(event, {"job": _job_to_dict(job)})
+
+    def fed_event(self, event: str, payload: dict) -> int:
+        """Durable federation record (``fed_reserve`` / ``fed_confirm``
+        / ``fed_release``): carries a lease payload instead of a job, so
+        :meth:`replay` skips it and :meth:`replay_fed` reconstructs the
+        lease table.  Returns the record's seq (the arbiter's durability
+        watermark — it must not act on the lease until
+        ``durable_seq >= seq``)."""
+        self._append_rec(event, {"fed": dict(payload)})
+        return self.seq
+
+    def _append_rec(self, event: str, body: dict) -> None:
         self.seq += 1
-        rec = {"seq": self.seq, "ev": event, "job": _job_to_dict(job)}
+        rec = {"seq": self.seq, "ev": event, **body}
         line = json.dumps(rec, separators=(",", ":"))
         if self._group_depth > 0:
             # group commit: buffer the encoded line; seq numbers stay
@@ -445,8 +458,29 @@ class WriteAheadLog:
         for rec in WriteAheadLog._iter_records(path):
             if after_seq and rec.get("seq", 0) <= after_seq:
                 continue
+            if "job" not in rec:
+                continue  # federation record — replay_fed's domain
             job = _job_from_dict(rec["job"])
             state[job.job_id] = (rec["ev"], job)
+        return state
+
+    @staticmethod
+    def replay_fed(path: str, after_seq: int = 0
+                   ) -> dict[str, tuple[str, dict]]:
+        """Last-writer-wins replay of federation lease records:
+        lease_id -> (last event, payload).  A lease whose last record is
+        ``fed_reserve`` was never confirmed nor released — recovery must
+        drop it (release the nodes) because only a ``fed_confirm``
+        record creates a job; this is what makes a shard crash mid-gang
+        safe against double placement."""
+        state: dict[str, tuple[str, dict]] = {}
+        for rec in WriteAheadLog._iter_records(path):
+            if after_seq and rec.get("seq", 0) <= after_seq:
+                continue
+            fed = rec.get("fed")
+            if fed is None:
+                continue
+            state[str(fed.get("lease_id", ""))] = (rec["ev"], fed)
         return state
 
     @staticmethod
@@ -500,6 +534,8 @@ class WriteAheadLog:
             # seq (follower cursors and segment ordering stay valid)
             last: dict[int, tuple[int, dict]] = {}
             for rec in self._iter_records(self.path):
+                if "job" not in rec:
+                    continue  # federation records survive separately
                 last[rec["job"]["job_id"]] = (rec.get("seq", 0), rec)
             for job_id, (seq, rec) in sorted(last.items()):
                 if not segments and \
@@ -507,6 +543,22 @@ class WriteAheadLog:
                     continue
                 keep.append((job_id, json.dumps(
                     rec, separators=(",", ":"))))
+        # federation lease records: keep each lease's last record unless
+        # it is resolved (confirmed or released) — dropping an
+        # unresolved fed_reserve would resurrect its nodes on recovery
+        # while the arbiter may still confirm against the lease
+        fed_last: dict[str, dict] = {}
+        for rec in self._iter_records(self.path):
+            fed = rec.get("fed")
+            if fed is not None:
+                fed_last[str(fed.get("lease_id", ""))] = rec
+        for lease_id in sorted(fed_last):
+            rec = fed_last[lease_id]
+            if not segments and rec["ev"] in ("fed_confirm",
+                                              "fed_release"):
+                continue
+            keep.append((lease_id, json.dumps(
+                rec, separators=(",", ":"))))
         self._fh.close()
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as out:

@@ -1,0 +1,29 @@
+"""Federated control plane: partition-sharded schedulers, a placement
+arbiter for cross-partition gangs, and a bounded-staleness scatter-
+gather query plane (ISSUE 15, ROADMAP open item #2).
+
+One logical cluster is split across controller *shards*.  Each shard is
+a full ctld — its own :class:`~cranesched_tpu.ctld.scheduler.JobScheduler`,
+pending table, and WAL — over a disjoint set of partitions, so submit
+ingest, accounting checks, and WAL fsyncs scale horizontally.  The only
+cross-shard authority is the :class:`~cranesched_tpu.fed.arbiter.
+PlacementArbiter`, which owns cross-partition gang jobs and commits
+them through two-phase reserve/confirm records in each shard's WAL
+under that shard's fencing epoch.
+
+Modules:
+
+* :mod:`.shardmap`  — the static partition→shard routing table (YAML
+  ``Federation:`` section).
+* :mod:`.shard`     — the per-shard lease plane grafted onto a local
+  JobScheduler (reserve / confirm / release / expire / recover).
+* :mod:`.arbiter`   — the cross-partition gang coordinator.
+* :mod:`.query`     — scatter-gather fan-out with the ``max_staleness``
+  read contract.
+* :mod:`.sim`       — an in-process federated cluster harness for the
+  replay drill and the fed test lane.
+"""
+
+from cranesched_tpu.fed.shardmap import ShardMap, ShardSpec
+
+__all__ = ["ShardMap", "ShardSpec"]

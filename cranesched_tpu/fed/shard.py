@@ -1,0 +1,292 @@
+"""Per-shard lease plane: the shard-side half of the arbiter's
+two-phase cross-partition gang commit.
+
+A shard owns its partitions outright — local jobs schedule through the
+normal cycle with no federation awareness.  The only cross-shard
+authority is the placement arbiter, and its entire contract with a
+shard is three operations grafted onto the local ``JobScheduler`` here:
+
+``lease_nodes``
+    Reserve concrete nodes for an arbiter solve.  The reservation is a
+    durable ``fed_reserve`` WAL record plus ``NodeMeta.fed_leased``
+    flags; the flag folds into ``schedulable``, so leased nodes vanish
+    from the local snapshot AND fail local mallocs — a shard cycle can
+    never race the arbiter onto a leased node.  The lease carries the
+    shard's CURRENT fencing epoch and a TTL: a dead arbiter's leases
+    self-expire, and a confirm under a stale epoch is refused (the
+    dispatch-ring fencing discipline, reused).
+
+``confirm_gang``
+    Turn one lease into a RUNNING local gang member.  The member is a
+    normal local job (submitted, committed, WAL'd, dispatched through
+    the ordinary dispatch ring) created inside one WAL group together
+    with the ``fed_confirm`` record — the ONLY record that creates a
+    job.  A crash before the group's fsync leaves a bare reserve, which
+    recovery drops; a crash after leaves the job durable exactly once.
+    Never double-placed, never half-placed.
+
+``release_lease``
+    Drop an unconfirmed reservation (arbiter abort, TTL expiry, or
+    recovery finding a reserve without a confirm).
+
+Recovery: :meth:`recover` replays ``fed_*`` records after the normal
+job replay.  Leases whose last record is ``fed_reserve`` are released
+(durable ``fed_release`` tombstone) — their gang was never committed
+here, and the arbiter's own retry logic re-places it from scratch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cranesched_tpu.ctld.defs import JobSpec, JobStatus, PendingReason
+from cranesched_tpu.ctld.meta import ResReduceEvent
+from cranesched_tpu.obs import REGISTRY as _OBS
+
+_MET_LEASES = _OBS.counter(
+    "crane_fed_leases_granted_total",
+    "arbiter node leases granted by this shard")
+_MET_REVOKED = _OBS.counter(
+    "crane_fed_leases_revoked_total",
+    "arbiter node leases released, expired, or dropped by recovery")
+
+
+@dataclasses.dataclass
+class Lease:
+    """One live reservation: which nodes, under which fencing epoch,
+    and when the shard self-expires it."""
+
+    lease_id: str
+    partition: str
+    node_ids: list[int]
+    epoch: int
+    deadline: float
+    seq: int = 0  # the fed_reserve record's WAL seq
+
+
+class FedShardPlane:
+    """Lease/confirm/release surface grafted onto one shard's
+    JobScheduler.  Callers (the RPC handlers, the in-process sim) hold
+    the shard's server lock — the plane itself is lock-free."""
+
+    def __init__(self, scheduler, shard_name: str):
+        self.scheduler = scheduler
+        self.shard = shard_name
+        scheduler.shard_name = shard_name
+        scheduler.fed = self
+        self.leases: dict[str, Lease] = {}
+
+    # -- reserve --
+
+    def free_count(self, partition: str, req: np.ndarray) -> int:
+        """How many nodes of ``partition`` could be leased for a
+        per-node requirement ``req`` right now (advisory — the answer
+        can go stale the moment the lock drops; the arbiter treats it
+        as a split hint, never a promise)."""
+        part = self.scheduler.meta.partitions.get(partition)
+        if part is None:
+            return 0
+        nodes = self.scheduler.meta.nodes
+        return sum(1 for nid in part.node_ids
+                   if nodes[nid].schedulable
+                   and (req <= nodes[nid].avail).all())
+
+    def lease_nodes(self, lease_id: str, partition: str, node_num: int,
+                    req: np.ndarray, ttl: float, now: float):
+        """Reserve ``node_num`` schedulable nodes of ``partition`` with
+        ``avail >= req`` each.  Returns (node_names, epoch, durable_seq)
+        or raises ValueError with the refusal reason."""
+        self.expire(now)
+        sched = self.scheduler
+        meta = sched.meta
+        if lease_id in self.leases:
+            raise ValueError(f"lease {lease_id!r} already held")
+        part = meta.partitions.get(partition)
+        if part is None:
+            raise ValueError(f"partition {partition!r} not owned by "
+                             f"shard {self.shard!r}")
+        chosen: list[int] = []
+        for nid in sorted(part.node_ids):
+            node = meta.nodes[nid]
+            if node.schedulable and (req <= node.avail).all():
+                chosen.append(nid)
+                if len(chosen) == node_num:
+                    break
+        if len(chosen) < node_num:
+            raise ValueError(
+                f"{partition}: only {len(chosen)}/{node_num} nodes free")
+        names = []
+        for nid in chosen:
+            node = meta.nodes[nid]
+            node.fed_leased = lease_id
+            # same revalidation trigger as a node death: an in-flight
+            # local cycle must not commit onto a node leased mid-solve
+            meta._log_event(ResReduceEvent(nid))
+            names.append(node.name)
+        epoch = sched.fencing_epoch
+        deadline = now + ttl if ttl > 0 else float("inf")
+        seq = 0
+        if sched.wal is not None:
+            seq = sched.wal.fed_event("fed_reserve", {
+                "lease_id": lease_id, "partition": partition,
+                "node_names": names, "epoch": epoch,
+                "deadline": deadline})
+        self.leases[lease_id] = Lease(lease_id, partition, list(chosen),
+                                      epoch, deadline, seq)
+        sched.events.emit(
+            "fed_lease_granted", "info", time=now,
+            detail=f"lease={lease_id} part={partition} "
+                   f"nodes={len(chosen)} epoch={epoch}")
+        _MET_LEASES.inc()
+        return names, epoch, seq
+
+    # -- confirm (phase two) --
+
+    def confirm_gang(self, lease_id: str, gang_id: str, spec: JobSpec,
+                     node_names: list[str], now: float,
+                     epoch: int = 0) -> int:
+        """Commit one gang member onto (a subset of) a lease's nodes.
+        Returns the shard-local job id; raises ValueError on refusal —
+        the lease stays held for the arbiter to release."""
+        sched = self.scheduler
+        meta = sched.meta
+        lease = self.leases.get(lease_id)
+        if lease is None:
+            raise ValueError(f"no such lease {lease_id!r}")
+        if epoch and epoch != sched.fencing_epoch:
+            raise ValueError(
+                f"fencing: lease epoch {epoch} != current "
+                f"{sched.fencing_epoch}")
+        if not node_names:
+            node_ids = list(lease.node_ids)
+        else:
+            name_to_id = meta._name_to_id
+            node_ids = []
+            for name in node_names:
+                nid = name_to_id.get(name)
+                if nid is None or nid not in lease.node_ids:
+                    raise ValueError(f"node {name!r} not in lease")
+                node_ids.append(nid)
+        # the whole lease returns to the local pool NOW: the confirmed
+        # subset is about to be malloc'd to the member, the rest frees.
+        # Safe against local racing because the caller holds the shard's
+        # server lock until the commit below is durable.
+        for nid in lease.node_ids:
+            meta.nodes[nid].fed_leased = ""
+        del self.leases[lease_id]
+
+        wal = sched.wal
+        try:
+            if wal is not None:
+                wal.begin_batch()
+            job_id = sched.submit(spec, now)
+            if not job_id:
+                raise ValueError("member spec rejected by submit")
+            job = sched.pending[job_id]
+            # the _commit_preemption template, minus eviction: admission
+            # first, then malloc with the leased nodes
+            if job.spec.licenses and not sched.licenses.malloc(
+                    job.spec.licenses):
+                sched.cancel(job_id, now)
+                raise ValueError("licenses exhausted")
+            if not sched._malloc_run_limits(job):
+                sched.licenses.free(job.spec.licenses or {})
+                sched.cancel(job_id, now)
+                raise ValueError("QoS run limit")
+            job.node_ids = list(node_ids)
+            job.alloc_cache = None
+            if not meta.malloc_resource(job.job_id, node_ids,
+                                        sched._job_alloc(job)):
+                sched.licenses.free(job.spec.licenses or {})
+                sched._free_run_limits(job)
+                job.node_ids = []
+                job.alloc_cache = None
+                sched.cancel(job_id, now)
+                raise ValueError("leased nodes no longer fit the spec")
+            del sched.pending[job_id]
+            job.status = JobStatus.RUNNING
+            job.start_time = now
+            job.pending_reason = PendingReason.NONE
+            sched._init_steps(job, now)
+            sched.running[job_id] = job
+            sched._ledger_add(job, now)
+            if wal is not None:
+                wal.job_started(job)
+                wal.fed_event("fed_confirm", {
+                    "lease_id": lease_id, "gang_id": gang_id,
+                    "job_id": job_id, "epoch": sched.fencing_epoch})
+            if sched.jobtrace is not None:
+                sched.jobtrace.stamp(job_id, job.requeue_count, "placed",
+                                     now, epoch=sched.fencing_epoch)
+            sched._trigger_dep_event(job)
+            sched._queue_dispatch(job, node_ids)
+        finally:
+            if wal is not None:
+                wal.commit_batch()
+        # durable-before-dispatch, the dispatch-ring discipline: the
+        # group's fsync returned above, so the drain pushes immediately
+        sched._drain_dispatch_ring()
+        return job_id
+
+    # -- release / expiry / recovery --
+
+    def release_lease(self, lease_id: str, now: float,
+                      detail: str = "released") -> bool:
+        sched = self.scheduler
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        for nid in lease.node_ids:
+            node = sched.meta.nodes.get(nid)
+            if node is not None and node.fed_leased == lease_id:
+                node.fed_leased = ""
+        if sched.wal is not None:
+            sched.wal.fed_event("fed_release", {
+                "lease_id": lease_id, "epoch": lease.epoch})
+        sched.events.emit(
+            "fed_lease_revoked", "warning", time=now,
+            detail=f"lease={lease_id} {detail}")
+        _MET_REVOKED.inc()
+        if sched.cycle_kick is not None:
+            sched.cycle_kick()  # freed nodes may unblock local pending
+        return True
+
+    def expire(self, now: float) -> int:
+        """Drop leases past their TTL (a dead arbiter never holds
+        capacity hostage).  Returns the number expired."""
+        due = [lid for lid, lease in self.leases.items()
+               if lease.deadline <= now]
+        for lid in due:
+            self.release_lease(lid, now, detail="ttl expired")
+        return len(due)
+
+    def recover(self, now: float) -> int:
+        """Post-replay cleanup: any lease whose last WAL record is a
+        bare ``fed_reserve`` was reserved but never confirmed before the
+        crash — write its release tombstone.  (Only ``fed_confirm``
+        creates a job, so nothing placed can be lost here; the arbiter
+        re-places the gang against fresh leases.)  Returns the number of
+        leases dropped."""
+        sched = self.scheduler
+        if sched.wal is None:
+            return 0
+        dropped = 0
+        state = sched.wal.replay_fed(sched.wal.path)
+        for lease_id, (ev, payload) in sorted(state.items()):
+            if ev != "fed_reserve":
+                continue
+            sched.wal.fed_event("fed_release", {
+                "lease_id": lease_id,
+                "epoch": payload.get("epoch", 0)})
+            sched.events.emit(
+                "fed_lease_revoked", "warning", time=now,
+                detail=f"lease={lease_id} dropped by recovery "
+                       "(reserve without confirm)")
+            _MET_REVOKED.inc()
+            dropped += 1
+        return dropped
+
+    def stats(self) -> dict:
+        return {"shard": self.shard, "leases": len(self.leases)}

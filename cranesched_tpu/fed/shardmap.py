@@ -1,0 +1,117 @@
+"""Shard map: the static partition -> controller-shard routing table.
+
+The federation is declared in the cluster YAML::
+
+    Federation:
+      ShardName: east            # identity of THIS controller process
+      Shards:
+        - name: east
+          partitions: [batch, debug]
+          address: 127.0.0.1:50051
+          followers: [127.0.0.1:50061]
+        - name: west
+          partitions: [gpu]
+          address: 127.0.0.1:50052
+
+Partitions are owned by exactly one shard (disjoint by construction —
+a partition listed twice is a config error).  The map is immutable at
+runtime: resharding is a config change + rolling restart, exactly like
+the node inventory.  Routing is therefore a pure dict lookup on both
+the client and the server; a submit that lands on the wrong shard is
+forwarded once and answered with a redirect hint so the client learns
+(see rpc/server.py SubmitBatchJob and client.HaCtldClient).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """One controller shard: a name, its partitions, and where it
+    listens (leader address first, then any HA followers that may
+    serve bounded-staleness reads)."""
+
+    name: str
+    partitions: tuple[str, ...]
+    address: str = ""
+    followers: tuple[str, ...] = ()
+
+    @property
+    def addresses(self) -> tuple[str, ...]:
+        """Leader address followed by follower addresses."""
+        out = (self.address,) if self.address else ()
+        return out + tuple(self.followers)
+
+
+class ShardMap:
+    """Immutable partition -> shard routing table."""
+
+    def __init__(self, shards: list[ShardSpec]):
+        if not shards:
+            raise ValueError("Federation declared with no shards")
+        self.shards: dict[str, ShardSpec] = {}
+        self._by_partition: dict[str, str] = {}
+        for spec in shards:
+            if spec.name in self.shards:
+                raise ValueError(f"duplicate shard {spec.name!r}")
+            self.shards[spec.name] = spec
+            for part in spec.partitions:
+                owner = self._by_partition.setdefault(part, spec.name)
+                if owner != spec.name:
+                    raise ValueError(
+                        f"partition {part!r} owned by both {owner!r} "
+                        f"and {spec.name!r} (shards must be disjoint)")
+
+    @classmethod
+    def from_config(cls, section: dict) -> "ShardMap":
+        """Parse the YAML ``Federation:`` section."""
+        shards = []
+        for entry in section.get("Shards", []) or []:
+            shards.append(ShardSpec(
+                name=str(entry["name"]),
+                partitions=tuple(str(p) for p in
+                                 entry.get("partitions", [])),
+                address=str(entry.get("address", "") or ""),
+                followers=tuple(str(a) for a in
+                                entry.get("followers", []) or [])))
+        return cls(shards)
+
+    def shard_for_partition(self, partition: str) -> str:
+        """Owning shard name, or '' for an unknown partition (the local
+        scheduler then rejects it with its normal diagnostics)."""
+        return self._by_partition.get(partition, "")
+
+    def spec(self, name: str) -> ShardSpec | None:
+        return self.shards.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self.shards)
+
+    def partitions_of(self, name: str) -> tuple[str, ...]:
+        spec = self.shards.get(name)
+        return spec.partitions if spec else ()
+
+    # -- wire form (QueryShardMap / ShardInfo) --
+
+    def doc(self) -> list[dict]:
+        """JSON-serializable shard list for the wire/CLI."""
+        return [{"name": s.name, "partitions": list(s.partitions),
+                 "address": s.address, "followers": list(s.followers)}
+                for s in (self.shards[n] for n in self.names())]
+
+    @classmethod
+    def from_doc(cls, doc: list[dict]) -> "ShardMap":
+        return cls([ShardSpec(
+            name=str(e["name"]),
+            partitions=tuple(str(p) for p in e.get("partitions", [])),
+            address=str(e.get("address", "") or ""),
+            followers=tuple(str(a) for a in e.get("followers", []) or []))
+            for e in doc])
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"ShardMap({', '.join(f'{n}:{list(s.partitions)}' for n, s in sorted(self.shards.items()))})")

@@ -1,0 +1,298 @@
+"""In-process federation harness: N controller shards + the placement
+arbiter on one virtual clock.
+
+Each :class:`SimShard` is a COMPLETE shard — its own MetaContainer,
+JobScheduler, WAL, simulated node plane, and
+:class:`~cranesched_tpu.fed.shard.FedShardPlane` — isolated exactly as
+a separate ctld process would be: shards share nothing but the arbiter
+handles and the shard map.  A lock per shard stands in for its RPC
+server's; :class:`ShardHandle` takes it around every arbiter call.
+
+Failure injection mirrors a SIGKILL, not a clean shutdown:
+:meth:`SimShard.kill` abandons the scheduler mid-flight (the WAL file
+keeps whatever was fsync'd, nothing is flushed on the way out) and
+every subsequent handle call raises.  :meth:`SimShard.recover` rebuilds
+the shard from its WAL alone — the same replay a restarted ctld runs —
+then :meth:`FedShardPlane.recover` drops reserved-but-unconfirmed
+leases.  Tests and the ``--federation`` replay assert the two-phase
+invariant on top: a kill between reserve and confirm never loses a
+placed job and never places one twice.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cranesched_tpu.craned.sim import SimCluster
+from cranesched_tpu.ctld.defs import JobSpec
+from cranesched_tpu.ctld.meta import MetaContainer
+from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.fed.arbiter import GangRequest, PlacementArbiter
+from cranesched_tpu.fed.shard import FedShardPlane
+from cranesched_tpu.fed.shardmap import ShardMap, ShardSpec
+from cranesched_tpu.ops.resources import ResourceLayout
+
+
+class SimShard:
+    """One in-process controller shard over disjoint partitions."""
+
+    def __init__(self, name: str, partitions: dict[str, int],
+                 cpu: float = 16.0, mem_gb: int = 64,
+                 wal_path: str | None = None, config_kw=None):
+        self.name = name
+        self.partitions = dict(partitions)
+        self.cpu = cpu
+        self.mem_gb = mem_gb
+        self.wal_path = wal_path
+        self.config_kw = dict(config_kw or {})
+        self.lock = threading.Lock()
+        self.alive = True
+        #: failure injection: die immediately after the NEXT successful
+        #: lease (reserve durable, confirm never answered) — the
+        #: arbiter's phase-two then hits a dead shard mid-gang
+        self.crash_after_lease = False
+        self._fresh_wal = True
+        self._build(now=0.0, replayed=None)
+
+    # -- construction / recovery --
+
+    def _build(self, now: float, replayed) -> None:
+        self.meta = MetaContainer(ResourceLayout())
+        nid = 0
+        for part in sorted(self.partitions):
+            for i in range(self.partitions[part]):
+                self.meta.add_node(
+                    f"{self.name}-{part}-n{i:04d}",
+                    self.meta.layout.encode(
+                        cpu=self.cpu, mem_bytes=self.mem_gb << 30,
+                        memsw_bytes=self.mem_gb << 30,
+                        is_capacity=True),
+                    partitions=(part,))
+                self.meta.craned_up(nid)
+                nid += 1
+        kw = dict(self.config_kw)
+        kw.setdefault("job_trace", True)
+        kw.setdefault("job_trace_capacity", 65536)
+        self.scheduler = JobScheduler(self.meta, SchedulerConfig(**kw))
+        if replayed is not None:
+            self.scheduler.recover(replayed, now)
+        if self.wal_path is not None:
+            if self._fresh_wal:
+                open(self.wal_path, "w").close()
+                self._fresh_wal = False
+            self.scheduler.wal = WriteAheadLog(self.wal_path)
+        self.sim = SimCluster(self.scheduler)
+        self.sim.now = now
+        self.sim.wire(self.scheduler)
+        self.fed = FedShardPlane(self.scheduler, self.name)
+        if replayed is not None:
+            self.fed.recover(now)
+            # the craneds of a real shard still run the re-adopted
+            # jobs; the simulated plane re-dispatches them instead
+            for job in self.scheduler.running.values():
+                self.sim.dispatch(job, job.node_ids)
+
+    def kill(self) -> None:
+        """SIGKILL analog: nothing is flushed or released — only what
+        the WAL already fsync'd survives into :meth:`recover`."""
+        self.alive = False
+
+    def recover(self, now: float) -> None:
+        """Restart from the WAL (requires ``wal_path``)."""
+        if self.wal_path is None:
+            raise RuntimeError("recover needs a WAL-backed shard")
+        replayed = WriteAheadLog.replay(self.wal_path)
+        self._build(now=now, replayed=replayed)
+        self.alive = True
+
+    # -- the local control surface (what the RPC handlers would do) --
+
+    def submit(self, spec: JobSpec, now: float) -> int:
+        if not self.alive:
+            raise RuntimeError(f"shard {self.name} is down")
+        with self.lock:
+            return self.scheduler.submit(spec, now)
+
+    def tick(self, now: float) -> list[int]:
+        """One scheduling cycle at virtual time ``now``."""
+        if not self.alive:
+            return []
+        with self.lock:
+            self.sim.advance_to(now)
+            self.fed.expire(now)
+            return self.scheduler.schedule_cycle(now)
+
+    def drained(self) -> bool:
+        return (not self.alive
+                or (not self.scheduler.pending
+                    and not self.scheduler.running))
+
+
+class ShardHandle:
+    """Arbiter-side handle over one :class:`SimShard` — the in-process
+    equivalent of the LeaseNodes/ConfirmGang/ReleaseLease RPC client,
+    including its failure mode (a dead shard raises)."""
+
+    def __init__(self, shard: SimShard):
+        self.shard = shard
+
+    def _check(self) -> None:
+        if not self.shard.alive:
+            raise RuntimeError(f"shard {self.shard.name} unreachable")
+
+    def _req(self, spec: JobSpec):
+        return spec.res.encode(self.shard.meta.layout)
+
+    def free_count(self, partition: str, spec: JobSpec) -> int:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.free_count(partition, self._req(spec))
+
+    def lease(self, lease_id: str, partition: str, count: int,
+              spec: JobSpec, ttl: float, now: float):
+        self._check()
+        with self.shard.lock:
+            out = self.shard.fed.lease_nodes(
+                lease_id, partition, count, self._req(spec), ttl, now)
+        if self.shard.crash_after_lease:
+            # one-shot: the reserve IS durable — the kill lands after
+            # the WAL fsync but before any confirm can be served
+            self.shard.crash_after_lease = False
+            self.shard.kill()
+        return out
+
+    def confirm(self, lease_id: str, gang_id: str, spec: JobSpec,
+                node_names, now: float, epoch: int = 0) -> int:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.confirm_gang(
+                lease_id, gang_id, spec, list(node_names), now,
+                epoch=epoch)
+
+    def release(self, lease_id: str, now: float) -> bool:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.release_lease(lease_id, now)
+
+    def cancel(self, job_id: int, now: float) -> bool:
+        self._check()
+        with self.shard.lock:
+            return self.shard.scheduler.cancel(job_id, now)
+
+
+class FederatedCluster:
+    """N shards + one arbiter on a shared virtual clock.
+
+    ``shards`` maps shard name -> {partition -> node count}.  Submits
+    route by partition through the shard map (exactly the lookup the
+    RPC layer does); cross-partition gangs go through the arbiter."""
+
+    def __init__(self, shards: dict[str, dict[str, int]],
+                 cpu: float = 16.0, mem_gb: int = 64,
+                 wal_dir: str | None = None, config_kw=None):
+        self.shards: dict[str, SimShard] = {}
+        specs = []
+        for name in sorted(shards):
+            wal_path = (f"{wal_dir}/{name}.wal"
+                        if wal_dir is not None else None)
+            self.shards[name] = SimShard(
+                name, shards[name], cpu=cpu, mem_gb=mem_gb,
+                wal_path=wal_path, config_kw=config_kw)
+            specs.append(ShardSpec(
+                name=name,
+                partitions=tuple(sorted(shards[name]))))
+        self.shard_map = ShardMap(specs)
+        self.handles = {name: ShardHandle(s)
+                        for name, s in self.shards.items()}
+        self.arbiter = PlacementArbiter(self.shard_map, self.handles)
+        self.now = 0.0
+
+    # -- routing --
+
+    def shard_for(self, partition: str) -> SimShard | None:
+        name = self.shard_map.shard_for_partition(partition)
+        return self.shards.get(name)
+
+    def submit(self, spec: JobSpec, now: float | None = None
+               ) -> tuple[str, int]:
+        """Route a partition-local submit; returns (shard, job_id)."""
+        shard = self.shard_for(spec.partition)
+        if shard is None:
+            raise ValueError(f"no shard owns partition "
+                             f"{spec.partition!r}")
+        return shard.name, shard.submit(
+            spec, self.now if now is None else now)
+
+    def submit_gang(self, gang: GangRequest) -> str:
+        return self.arbiter.submit_gang(gang)
+
+    # -- the clock --
+
+    def tick(self, now: float | None = None) -> int:
+        """Advance every live shard one cycle, then pump the arbiter.
+        Returns the number of jobs started across the federation."""
+        self.now = self.now + 1.0 if now is None else now
+        started = 0
+        for shard in self.shards.values():
+            started += len(shard.tick(self.now))
+        started += sum(
+            len(self.arbiter.committed[gid])
+            for gid in self.arbiter.pump(self.now))
+        return started
+
+    def run_until_drained(self, max_cycles: int = 100_000) -> float:
+        """Alternate ticks until every live shard drained and the
+        arbiter queue is empty (virtual clock, like the single-cluster
+        ``SimCluster.run_until_drained``)."""
+        for _ in range(max_cycles):
+            self.tick()
+            if self.arbiter.queue:
+                continue
+            if all(s.drained() for s in self.shards.values()):
+                return self.now
+        return self.now
+
+    # -- failure injection / audit --
+
+    def kill(self, name: str) -> None:
+        self.shards[name].kill()
+
+    def recover(self, name: str, now: float | None = None) -> None:
+        self.shards[name].recover(self.now if now is None else now)
+        # the rebuilt FedShardPlane is a new object — rebind the handle
+        self.handles[name].shard = self.shards[name]
+
+    def ledger(self) -> dict:
+        """Cross-shard lost/doubled audit from each shard's jobtrace
+        ledger, keyed by shard."""
+        out = {"lost": 0, "doubled": 0, "checked": 0, "shards": {}}
+        for name, shard in self.shards.items():
+            sched = shard.scheduler
+            ids = sorted(set(sched.history) | set(sched.running)
+                         | set(sched.pending))
+            doc = (sched.jobtrace.ledger(ids)
+                   if sched.jobtrace is not None else
+                   {"lost": 0, "doubled": 0, "checked": 0})
+            out["shards"][name] = doc
+            out["lost"] += (doc["lost"] if isinstance(doc["lost"], int)
+                            else len(doc["lost"]))
+            out["doubled"] += (doc["doubled"]
+                               if isinstance(doc["doubled"], int)
+                               else len(doc["doubled"]))
+            out["checked"] += doc["checked"]
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "now": self.now,
+            "arbiter": dict(self.arbiter.stats),
+            "shards": {
+                name: {
+                    "alive": s.alive,
+                    "pending": len(s.scheduler.pending),
+                    "running": len(s.scheduler.running),
+                    "finished": len(s.scheduler.history),
+                    "leases": len(s.fed.leases),
+                } for name, s in self.shards.items()},
+        }

@@ -73,6 +73,10 @@ class HaFollower(threading.Thread):
 
         self.applied_seq = 0
         self.leader_seq = 0
+        # monotonic timestamp of the last poll that left us caught up
+        # with the leader's durable tail; feeds the bounded-staleness
+        # read contract (fed/query.py, rpc/server.py max_staleness)
+        self._caught_up_at = 0.0
         self.promoted = threading.Event()
         self._stop = threading.Event()
         self._misses = 0
@@ -143,12 +147,28 @@ class HaFollower(threading.Thread):
         with self.server._lock:
             for rec in records:
                 parsed = json.loads(rec.payload)
+                self.applied_seq = max(self.applied_seq,
+                                       int(rec.seq))
+                if "job" not in parsed:
+                    # federation lease record (fed_reserve/confirm/
+                    # release): durable in the local WAL above for a
+                    # post-promotion replay_fed, but not shadow state
+                    continue
                 job = _job_from_dict(parsed["job"])
                 self._state[job.job_id] = (parsed["ev"], job)
                 self._mirror_job(job)
-                self.applied_seq = max(self.applied_seq,
-                                       int(rec.seq))
         return len(records)
+
+    def staleness(self) -> float:
+        """Upper bound, in seconds, on how stale this follower's view
+        is: time since the last replication poll that left us caught up
+        with the leader's durable tail.  ``inf`` before the first full
+        sync — a follower that has never caught up must refuse any
+        bounded-staleness read."""
+        at = self._caught_up_at
+        if at <= 0.0:
+            return float("inf")
+        return max(0.0, time.monotonic() - at)
 
     # -- leader polling --
 
@@ -213,6 +233,8 @@ class HaFollower(threading.Thread):
             self.leader_seq = int(rep.wal_seq)
             _ha.LAG_GAUGE.set(max(0, self.leader_seq - self.applied_seq))
             self._misses = 0
+            if self.applied_seq >= self.leader_seq:
+                self._caught_up_at = time.monotonic()
             return True
         except grpc.RpcError as e:
             # only an UNREACHABLE leader is evidence for failover

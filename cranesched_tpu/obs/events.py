@@ -42,6 +42,13 @@ docs assert on lives in :data:`EVENT_TYPES`:
     requeue (info)            a job went back to pending
     recompile_steady (warning) a warm cycle paid a fresh jit compile
     profile_capture (info)    a profiler window started/stopped
+    fed_lease_granted (info)  this shard leased nodes to the arbiter
+    fed_lease_revoked (warning) a lease expired/aborted and was dropped
+    fed_forward (info)        a misrouted submit was forwarded
+    fed_arbiter_commit (info) a cross-partition gang fully confirmed
+    fed_arbiter_abort (warning) a partially-confirmed gang was undone
+    cgroup_adopt_fallback (warning) PAM adoption granted access without
+                              cgroup containment (cgroupfs unavailable)
 """
 
 from __future__ import annotations
@@ -61,6 +68,14 @@ EVENT_TYPES = frozenset({
     "node_poweroff", "node_wake", "fencing_rejection", "watchdog_crash",
     "failover", "slo_breach", "slo_clear", "preemption", "requeue",
     "recompile_steady", "profile_capture",
+    # federated control plane (fed/): lease lifecycle on the shard,
+    # misrouted-submit forwarding, arbiter two-phase outcomes
+    "fed_lease_granted", "fed_lease_revoked", "fed_forward",
+    "fed_arbiter_commit", "fed_arbiter_abort",
+    # craned PAM adoption fell back past cgroup containment (the
+    # best-effort gap in craned/daemon.py, surfaced so drills can
+    # assert on it instead of grepping logs)
+    "cgroup_adopt_fallback",
 })
 
 #: a node_up this many seconds after a node_down counts as a flap

@@ -391,6 +391,134 @@ def replay_topo(scale: float, rng, run=_run_direct):
     return out
 
 
+def replay_federation(scale: float, rng, wal_dir: str | None = None,
+                      kill_shard: str = "east"):
+    """Closed-loop federation drill (REPLAY_r07): two WAL-backed shards
+    + the placement arbiter on one virtual clock, a submit storm that is
+    40% cross-partition gangs, and one shard SIGKILL'd mid-storm at the
+    worst possible moment — immediately after a durable gang reserve,
+    before any confirm.  The run audits itself: the cross-shard jobtrace
+    ledger must show zero lost and zero double-dispatched jobs, and
+    every committed gang member must appear exactly once."""
+    import collections
+    import shutil
+    import tempfile
+
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    from cranesched_tpu.fed.arbiter import GangRequest
+    from cranesched_tpu.fed.sim import FederatedCluster
+
+    n_per_part = max(int(100 * scale), 4)
+    n_jobs = max(int(2000 * scale), 60)
+    tmp = wal_dir or tempfile.mkdtemp(prefix="crane-fed-replay-")
+    fc = FederatedCluster(
+        {"east": {"batch": n_per_part,
+                  "debug": max(n_per_part // 2, 2)},
+         "west": {"gpu": n_per_part}},
+        cpu=16.0, mem_gb=64, wal_dir=tmp)
+    parts = ("batch", "debug", "gpu")
+    events = []
+    for i in range(n_jobs):
+        res = ResourceSpec(cpu=float(rng.integers(1, 5)),
+                           mem_bytes=int(rng.integers(1, 9)) << 30,
+                           memsw_bytes=int(rng.integers(1, 9)) << 30)
+        runtime = float(rng.integers(5, 60))
+        if rng.random() < 0.4:
+            events.append(GangRequest(
+                name=f"g{i:05d}",
+                node_num=int(rng.integers(2, 5)),
+                partitions=("batch", "gpu"),
+                spec=JobSpec(user="u", res=res, sim_runtime=runtime)))
+        else:
+            events.append(JobSpec(
+                name=f"j{i:05d}", user="u",
+                partition=parts[int(rng.integers(0, 3))],
+                res=res, sim_runtime=runtime))
+
+    wave = max(n_jobs // 40, 1)
+    kill_at = n_jobs // 2
+    backlog = collections.deque(events)
+    t0 = time.perf_counter()
+    submitted = gangs = 0
+    killed_t = recovered_t = None
+    while backlog:
+        # one wave per tick; a refused submit (shard down) stays queued
+        # exactly as a retrying client would hold it
+        for _ in range(min(wave, len(backlog))):
+            ev = backlog[0]
+            if isinstance(ev, GangRequest):
+                fc.submit_gang(ev)
+                gangs += 1
+            else:
+                try:
+                    fc.submit(ev)
+                except RuntimeError:
+                    break  # owning shard is down — retry next tick
+            backlog.popleft()
+            submitted += 1
+        if killed_t is None and submitted >= kill_at:
+            # arm the worst-case SIGKILL: it lands right after the next
+            # durable fed_reserve on this shard, before any confirm
+            fc.shards[kill_shard].crash_after_lease = True
+            killed_t = fc.now
+        if (recovered_t is None and killed_t is not None
+                and not fc.shards[kill_shard].alive
+                and fc.now >= killed_t + 10.0):
+            fc.recover(kill_shard)
+            recovered_t = fc.now
+        fc.tick()
+    if not fc.shards[kill_shard].alive:
+        fc.recover(kill_shard)
+        recovered_t = fc.now
+    fc.run_until_drained()
+    wall = time.perf_counter() - t0
+
+    ledger = fc.ledger()
+    # every committed gang member exists exactly once across the
+    # federation, and no gang was silently dropped
+    member_counts = collections.Counter(
+        j.spec.name
+        for s in fc.shards.values()
+        for j in list(s.scheduler.history.values())
+        + list(s.scheduler.running.values())
+        if j.spec.name.startswith("g"))
+    stats = fc.arbiter.stats
+    finished = sum(len(s.scheduler.history)
+                   for s in fc.shards.values())
+    completed = sum(
+        1 for s in fc.shards.values()
+        for j in s.scheduler.history.values()
+        if j.status.value == "Completed")
+    ok = bool(
+        ledger["lost"] == 0 and ledger["doubled"] == 0
+        and stats["failed"] == 0 and not fc.arbiter.queue
+        and stats["commits"] == gangs
+        and all(c == 1 for c in member_counts.values()))
+    if wal_dir is None:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dict(
+        mode="federation",
+        shards={name: dict(s.partitions)
+                for name, s in fc.shards.items()},
+        jobs_submitted=n_jobs,
+        gangs=gangs,
+        gang_share=round(gangs / n_jobs, 3),
+        gang_commits=stats["commits"],
+        gang_aborts=stats["aborts"],
+        killed_shard=kill_shard,
+        killed_at=killed_t,
+        recovered_at=recovered_t,
+        jobs_finished=finished,
+        completed=completed,
+        cycles=int(fc.now),
+        virtual_drain_s=fc.now,
+        wall_s=round(wall, 3),
+        jobs_per_wall_s=round(finished / wall, 1) if wall else 0.0,
+        ledger=ledger,
+        ok=ok,
+    )
+
+
 CONFIGS = {
     "fifo": replay_fifo,
     "minload": replay_minload,
@@ -403,7 +531,7 @@ CONFIGS = {
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="crane-replay")
-    ap.add_argument("config", choices=[*CONFIGS, "all"])
+    ap.add_argument("config", nargs="?", choices=[*CONFIGS, "all"])
     ap.add_argument("--scale", type=float, default=0.1,
                     help="fraction of the full BASELINE shape")
     ap.add_argument("--seed", type=int, default=0)
@@ -417,7 +545,15 @@ def main(argv=None) -> int:
                     help="closed-loop mode: drive --rpc, then assert "
                          "the SLO/ledger contract from the run's own "
                          "exported telemetry")
+    ap.add_argument("--federation", action="store_true",
+                    help="closed-loop federation drill: 2 WAL-backed "
+                         "shards + the arbiter, 40%% cross-partition "
+                         "gangs, one shard SIGKILL'd mid-storm; "
+                         "asserts zero lost/doubled via the jobtrace "
+                         "ledger")
     args = ap.parse_args(argv)
+    if args.config is None and not args.federation:
+        ap.error("a config is required unless --federation is given")
 
     run = _run_direct
     if args.slo:
@@ -428,11 +564,15 @@ def main(argv=None) -> int:
         import functools
         run = functools.partial(_run_rpc, wal_path=args.wal or None)
 
-    names = list(CONFIGS) if args.config == "all" else [args.config]
+    names = ([] if args.config is None else
+             list(CONFIGS) if args.config == "all" else [args.config])
     results = {}
     for name in names:
         rng = np.random.default_rng(args.seed)
         results[name] = CONFIGS[name](args.scale, rng, run=run)
+    if args.federation:
+        rng = np.random.default_rng(args.seed)
+        results["federation"] = replay_federation(args.scale, rng)
     if args.json:
         print(json.dumps(results))
     else:

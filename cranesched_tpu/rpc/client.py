@@ -41,8 +41,11 @@ class CtldClient:
 
     # ---- external ----
 
-    def submit(self, spec: pb.JobSpec) -> pb.SubmitJobReply:
-        return self._call("SubmitBatchJob", pb.SubmitJobRequest(spec=spec),
+    def submit(self, spec: pb.JobSpec,
+               forwarded: bool = False) -> pb.SubmitJobReply:
+        return self._call("SubmitBatchJob",
+                          pb.SubmitJobRequest(spec=spec,
+                                              forwarded=forwarded),
                           pb.SubmitJobReply)
 
     def submit_many(self, specs) -> pb.SubmitJobsReply:
@@ -81,21 +84,23 @@ class CtldClient:
 
     def query_jobs(self, job_ids=(), user: str = "", partition: str = "",
                    include_history: bool = False, limit: int = 0,
-                   after_job_id: int = 0) -> pb.QueryJobsReply:
+                   after_job_id: int = 0,
+                   max_staleness: float = 0.0) -> pb.QueryJobsReply:
         return self._call(
             "QueryJobsInfo",
             pb.QueryJobsRequest(job_ids=list(job_ids), user=user,
                                 partition=partition,
                                 include_history=include_history,
                                 limit=limit,
-                                after_job_id=after_job_id),
+                                after_job_id=after_job_id,
+                                max_staleness=max_staleness),
             pb.QueryJobsReply)
 
     def query_jobs_stream(self, job_ids=(), user: str = "",
                           partition: str = "",
                           include_history: bool = False,
                           limit: int = 0, after_job_id: int = 0,
-                          result=None):
+                          result=None, max_staleness: float = 0.0):
         """Yield JobInfo messages from the server-streaming query
         (chunked on the wire; flattened here).  Pass a
         ``StreamResult`` as ``result`` to learn whether the server
@@ -103,16 +108,19 @@ class CtldClient:
         request = pb.QueryJobsRequest(
             job_ids=list(job_ids), user=user, partition=partition,
             include_history=include_history, limit=limit,
-            after_job_id=after_job_id)
+            after_job_id=after_job_id, max_staleness=max_staleness)
         for reply in self._stub.call_stream("QueryJobsStream", request,
                                             pb.QueryJobsReply):
             if reply.truncated and result is not None:
                 result.truncated = True
             yield from reply.jobs
 
-    def query_cluster(self) -> pb.QueryClusterReply:
-        return self._call("QueryClusterInfo", pb.QueryClusterRequest(),
-                          pb.QueryClusterReply)
+    def query_cluster(self, max_staleness: float = 0.0
+                      ) -> pb.QueryClusterReply:
+        return self._call(
+            "QueryClusterInfo",
+            pb.QueryClusterRequest(max_staleness=max_staleness),
+            pb.QueryClusterReply)
 
     def create_reservation(self, name, partition, node_names, start_time,
                            end_time, allowed_accounts=(),
@@ -136,8 +144,10 @@ class CtldClient:
                           pb.ModifyNodeRequest(name=name, action=action),
                           pb.OkReply)
 
-    def query_stats(self) -> pb.StatsReply:
-        return self._call("QueryStats", pb.StatsRequest(), pb.StatsReply)
+    def query_stats(self, max_staleness: float = 0.0) -> pb.StatsReply:
+        return self._call("QueryStats",
+                          pb.StatsRequest(max_staleness=max_staleness),
+                          pb.StatsReply)
 
     def acct_mgr(self, actor: str, action: str,
                  payload: dict | None = None) -> pb.AcctMgrReply:
@@ -234,14 +244,15 @@ class CtldClient:
                           pb.OkReply)
 
     def query_job_summary(self, user: str = "", partition: str = "",
-                          job_id: int = 0
+                          job_id: int = 0, max_staleness: float = 0.0
                           ) -> pb.QueryJobSummaryReply:
         """job_id != 0 additionally returns that job's timeline as
         JSON (standby-servable, like the summary itself)."""
         return self._call(
             "QueryJobSummary",
             pb.QueryJobSummaryRequest(user=user, partition=partition,
-                                      job_id=job_id),
+                                      job_id=job_id,
+                                      max_staleness=max_staleness),
             pb.QueryJobSummaryReply)
 
     def ha_status(self) -> pb.HaStatusReply:
@@ -262,13 +273,15 @@ class CtldClient:
 
     def query_events(self, severity: str = "", since: float = 0.0,
                      after_seq: int = 0, limit: int = 0,
-                     type: str = "") -> pb.QueryEventsReply:
+                     type: str = "",
+                     max_staleness: float = 0.0) -> pb.QueryEventsReply:
         """Structured cluster-event ring (standby-servable)."""
         return self._call(
             "QueryEvents",
             pb.QueryEventsRequest(severity=severity, since=since,
                                   after_seq=after_seq, limit=limit,
-                                  type=type),
+                                  type=type,
+                                  max_staleness=max_staleness),
             pb.QueryEventsReply)
 
     def capture_profile(self, cycles: int = 1,
@@ -278,6 +291,40 @@ class CtldClient:
             "CaptureProfile",
             pb.CaptureProfileRequest(cycles=cycles, dir=dir),
             pb.CaptureProfileReply)
+
+    # ---- federation (fed/) ----
+
+    def query_shard_map(self) -> pb.QueryShardMapReply:
+        return self._call("QueryShardMap", pb.QueryShardMapRequest(),
+                          pb.QueryShardMapReply)
+
+    def lease_nodes(self, lease_id: str, partition: str, node_num: int,
+                    res: pb.ResourceSpec | None = None,
+                    ttl: float = 0.0) -> pb.LeaseNodesReply:
+        req = pb.LeaseNodesRequest(lease_id=lease_id, partition=partition,
+                                   node_num=node_num, ttl=ttl)
+        if res is not None:
+            req.res.CopyFrom(res)
+        return self._call("LeaseNodes", req, pb.LeaseNodesReply)
+
+    def confirm_gang(self, lease_id: str, gang_id: str,
+                     spec: pb.JobSpec, node_names=(),
+                     fencing_epoch: int = 0) -> pb.ConfirmGangReply:
+        return self._call(
+            "ConfirmGang",
+            pb.ConfirmGangRequest(lease_id=lease_id, gang_id=gang_id,
+                                  spec=spec,
+                                  node_names=list(node_names),
+                                  fencing_epoch=fencing_epoch),
+            pb.ConfirmGangReply)
+
+    def release_lease(self, lease_id: str,
+                      fencing_epoch: int = 0) -> pb.OkReply:
+        return self._call(
+            "ReleaseLease",
+            pb.ReleaseLeaseRequest(lease_id=lease_id,
+                                   fencing_epoch=fencing_epoch),
+            pb.OkReply)
 
 
 # gRPC codes that mean "try the next ctld": the endpoint is down/
@@ -312,6 +359,12 @@ class HaCtldClient(CtldClient):
         self._clients: dict[int, CtldClient] = {}
         # CtldClient API compat (tests introspect .address/._stub)
         self.address = self.addresses[0]
+        # federation routing: partition -> shard leader address,
+        # learned from SubmitJobReply redirect hints (or pre-seeded by
+        # learn_shard_map); addresses here may lie OUTSIDE the HA
+        # rotation list, so their clients live in their own cache
+        self._shard_routes: dict[str, str] = {}
+        self._route_clients: dict[str, CtldClient] = {}
 
     def _at(self, idx: int) -> CtldClient:
         cli = self._clients.get(idx)
@@ -329,6 +382,62 @@ class HaCtldClient(CtldClient):
         for cli in self._clients.values():
             cli.close()
         self._clients.clear()
+        for cli in self._route_clients.values():
+            cli.close()
+        self._route_clients.clear()
+
+    # -- federation: shard-aware submit routing --
+
+    def learn_shard_map(self) -> int:
+        """Pre-seed partition routes from any reachable ctld's
+        QueryShardMap.  Returns the number of partitions learned (0 on
+        a non-federated cluster)."""
+        try:
+            reply = self.query_shard_map()
+        except grpc.RpcError:
+            return 0
+        n = 0
+        for shard in reply.shards:
+            if not shard.address:
+                continue
+            for part in shard.partitions:
+                self._shard_routes[part] = shard.address
+                n += 1
+        return n
+
+    def _route(self, address: str) -> CtldClient:
+        cli = self._route_clients.get(address)
+        if cli is None:
+            cli = CtldClient(address, timeout=self.timeout,
+                             token=self._token, tls=self._tls)
+            self._route_clients[address] = cli
+        return cli
+
+    def submit(self, spec: pb.JobSpec,
+               forwarded: bool = False) -> pb.SubmitJobReply:
+        """Route the submit to the partition's owning shard when the
+        route is known; otherwise fall back to the HA rotation (the
+        server forwards misrouted submits and answers with a redirect
+        hint, which teaches us the route for next time)."""
+        addr = self._shard_routes.get(spec.partition)
+        if addr:
+            try:
+                return self._route(addr).submit(spec, forwarded=forwarded)
+            except grpc.RpcError as e:
+                if e.code() not in _ROTATE_CODES:
+                    raise
+                # the learned route went stale — drop it and fall back
+                self._shard_routes.pop(spec.partition, None)
+                cli = self._route_clients.pop(addr, None)
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:
+                        pass
+        reply = super().submit(spec, forwarded=forwarded)
+        if reply.redirect_address:
+            self._shard_routes[spec.partition] = reply.redirect_address
+        return reply
 
     def _call(self, name, request, reply_cls):
         last_err = None

@@ -137,6 +137,9 @@ class CraneConfig:
     # or explicit {Blocks, Switches} tree — empty = no topology (gangs
     # place with no locality restriction)
     topology: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # federated control plane (fed/): Federation: {ShardName, Shards:
+    # [{name, partitions, address}]} — empty = single-controller cluster
+    federation: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def metrics_port(self) -> int | None:
@@ -154,6 +157,20 @@ class CraneConfig:
             key=str(self.tls.get("Key", "") or ""),
             require_client_cert=bool(
                 self.tls.get("RequireClientCert", False)))
+
+    def shard_map(self):
+        """-> fed.shardmap.ShardMap from the ``Federation:`` section, or
+        None for a single-controller cluster."""
+        if not self.federation:
+            return None
+        from cranesched_tpu.fed.shardmap import ShardMap
+        return ShardMap.from_config(self.federation)
+
+    @property
+    def shard_name(self) -> str:
+        """This controller's shard identity (``Federation: ShardName``);
+        empty string outside a federation."""
+        return str(self.federation.get("ShardName", "") or "")
 
     def build(self):
         """-> (MetaContainer, JobScheduler); nodes start down until their
@@ -372,4 +389,5 @@ def load_config(path: str) -> CraneConfig:
         tls=raw.get("Tls", {}) or {},
         license_sync=raw.get("LicenseSync", {}) or {},
         observability=raw.get("Observability", {}) or {},
-        topology=raw.get("Topology", {}) or {})
+        topology=raw.get("Topology", {}) or {},
+        federation=raw.get("Federation", {}) or {})

@@ -1,0 +1,404 @@
+"""Federated control plane (fed/): partition-sharded scheduling,
+misrouted-submit forwarding, the arbiter's two-phase gang commit, and
+the bounded-staleness query plane.
+
+All tests here run in the ``make tier1-fed`` lane (``-m fed``); they
+are fast enough for tier-1 too.
+"""
+
+import socket
+
+import grpc
+import pytest
+
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.fed.arbiter import GangRequest
+from cranesched_tpu.fed.shard import FedShardPlane
+from cranesched_tpu.fed.shardmap import ShardMap, ShardSpec
+from cranesched_tpu.fed.sim import FederatedCluster, SimShard
+from cranesched_tpu.obs import REGISTRY
+from cranesched_tpu.rpc import crane_pb2 as pb, serve
+from cranesched_tpu.rpc.client import CtldClient, HaCtldClient
+
+pytestmark = pytest.mark.fed
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pb_spec(cpu=1.0, runtime=30.0, **kw):
+    return pb.JobSpec(res=pb.ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                          memsw_bytes=1 << 30),
+                      sim_runtime=runtime, **kw)
+
+
+def _shard_sched(name, partitions, nodes_per=2):
+    meta = MetaContainer()
+    nid = 0
+    for part in partitions:
+        for i in range(nodes_per):
+            meta.add_node(f"{name}-{part}-n{i}",
+                          meta.layout.encode(cpu=8.0,
+                                             mem_bytes=16 << 30,
+                                             memsw_bytes=16 << 30,
+                                             is_capacity=True),
+                          partitions=(part,))
+            meta.craned_up(nid)
+            nid += 1
+    return JobScheduler(meta, SchedulerConfig(backfill=False))
+
+
+def _fed_pair(tls=None):
+    """Two live shard servers wired through one shard map: east owns
+    batch+debug, west owns gpu."""
+    ports = {"east": _free_port(), "west": _free_port()}
+    shard_map = ShardMap([
+        ShardSpec("east", ("batch", "debug"),
+                  address=f"127.0.0.1:{ports['east']}"),
+        ShardSpec("west", ("gpu",),
+                  address=f"127.0.0.1:{ports['west']}"),
+    ])
+    servers = {}
+    for name in ("east", "west"):
+        sched = _shard_sched(name, shard_map.partitions_of(name))
+        server, bound = serve(sched, tick_mode=True,
+                              address=f"127.0.0.1:{ports[name]}",
+                              shard_name=name, shard_map=shard_map)
+        assert bound == ports[name]
+        servers[name] = server
+    return shard_map, ports, servers
+
+
+# ---------------------------------------------------------------------------
+# shard map
+# ---------------------------------------------------------------------------
+
+def test_shard_map_routing_and_disjointness():
+    m = ShardMap.from_config({"Shards": [
+        {"name": "east", "partitions": ["batch", "debug"],
+         "address": "h1:1", "followers": ["h1:2"]},
+        {"name": "west", "partitions": ["gpu"], "address": "h2:1"},
+    ]})
+    assert m.shard_for_partition("gpu") == "west"
+    assert m.shard_for_partition("debug") == "east"
+    assert m.shard_for_partition("nope") == ""
+    assert m.spec("east").addresses == ("h1:1", "h1:2")
+    # the wire form round-trips
+    assert ShardMap.from_doc(m.doc()).doc() == m.doc()
+    # a partition owned twice is a config error, not a runtime surprise
+    with pytest.raises(ValueError, match="disjoint"):
+        ShardMap([ShardSpec("a", ("p",)), ShardSpec("b", ("p",))])
+
+
+# ---------------------------------------------------------------------------
+# RPC layer: routing + forwarding
+# ---------------------------------------------------------------------------
+
+def test_misrouted_submit_forwarded_and_route_learned():
+    shard_map, ports, servers = _fed_pair()
+    east = west = ha = None
+    try:
+        east = CtldClient(f"127.0.0.1:{ports['east']}")
+        fwd0 = REGISTRY.counter("crane_fed_forwards_total").value()
+        # a gpu submit landing on east is forwarded to west one hop,
+        # answered with west's job id + a redirect hint
+        reply = east.submit(_pb_spec(partition="gpu"))
+        assert reply.job_id == 1 and not reply.error
+        assert reply.shard == "west"
+        assert reply.redirect_address == f"127.0.0.1:{ports['west']}"
+        assert servers["west"].scheduler.job_info(1) is not None
+        assert servers["east"].scheduler.job_info(1) is None
+        assert REGISTRY.counter(
+            "crane_fed_forwards_total").value() == fwd0 + 1
+        assert any(e["type"] == "fed_forward" for e in
+                   servers["east"].scheduler.events.since())
+        # local partitions never forward
+        local = east.submit(_pb_spec(partition="batch"))
+        assert local.shard == "east" and local.job_id == 1
+        # a shard-aware client learns the route from the redirect and
+        # stops paying the extra hop
+        ha = HaCtldClient([f"127.0.0.1:{ports['east']}"])
+        assert ha.submit(_pb_spec(partition="gpu")).job_id == 2
+        fwd1 = REGISTRY.counter("crane_fed_forwards_total").value()
+        assert ha.submit(_pb_spec(partition="gpu")).job_id == 3
+        assert REGISTRY.counter(
+            "crane_fed_forwards_total").value() == fwd1  # direct hit
+        # batch submits route per-spec outside the lock
+        west = CtldClient(f"127.0.0.1:{ports['west']}")
+        many = east.submit_many([_pb_spec(partition="batch"),
+                                 _pb_spec(partition="gpu")])
+        assert [r.shard for r in many.replies] == ["east", "west"]
+        assert all(r.job_id for r in many.replies)
+    finally:
+        for c in (east, west, ha):
+            if c is not None:
+                c.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_already_forwarded_submit_redirects_instead_of_looping():
+    """Two shards with skewed maps must redirect-bounce the client,
+    never build a forwarding loop: a request already marked forwarded
+    is answered with the redirect hint and no job."""
+    shard_map, ports, servers = _fed_pair()
+    east = None
+    try:
+        east = CtldClient(f"127.0.0.1:{ports['east']}")
+        reply = east.submit(_pb_spec(partition="gpu"), forwarded=True)
+        assert reply.job_id == 0
+        assert "belongs to shard 'west'" in reply.error
+        assert reply.redirect_address == f"127.0.0.1:{ports['west']}"
+        assert servers["west"].scheduler.job_info(1) is None
+    finally:
+        if east is not None:
+            east.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_query_shard_map_and_fed_stats():
+    shard_map, ports, servers = _fed_pair()
+    cli = None
+    try:
+        cli = CtldClient(f"127.0.0.1:{ports['east']}")
+        reply = cli.query_shard_map()
+        assert reply.shard == "east" and not reply.error
+        assert [s.name for s in reply.shards] == ["east", "west"]
+        assert list(reply.shards[0].partitions) == ["batch", "debug"]
+        # the stats doc carries the shard identity + map for cstats
+        import json
+        doc = json.loads(cli.query_stats().json)
+        assert doc["fed"]["shard"] == "east"
+        assert [s["name"] for s in doc["fed"]["shards"]] == \
+            ["east", "west"]
+    finally:
+        if cli is not None:
+            cli.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_lease_confirm_release_rpc_roundtrip():
+    """The arbiter's three verbs over the real wire: reserve nodes,
+    confirm a member under the fencing epoch, release the rest."""
+    shard_map, ports, servers = _fed_pair()
+    sched = servers["east"].scheduler
+    FedShardPlane(sched, "east")
+    cli = None
+    try:
+        cli = CtldClient(f"127.0.0.1:{ports['east']}")
+        res = pb.ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                              memsw_bytes=1 << 30)
+        lease = cli.lease_nodes("L1", "batch", 2, res=res, ttl=60.0)
+        assert lease.ok and len(lease.node_names) == 2
+        # leased nodes vanish from the local pool
+        assert all(not sched.meta.node_by_name(n).schedulable
+                   for n in lease.node_names)
+        # an overlapping second lease finds nothing left
+        refuse = cli.lease_nodes("L2", "batch", 1, res=res, ttl=60.0)
+        assert not refuse.ok and "0/1 nodes free" in refuse.error
+        conf = cli.confirm_gang(
+            "L1", "g1", _pb_spec(partition="batch", name="g1@batch",
+                                 node_num=2),
+            node_names=list(lease.node_names),
+            fencing_epoch=lease.fencing_epoch)
+        assert conf.ok and conf.job_id == 1
+        assert sched.running[1].spec.name == "g1@batch"
+        # a confirm under a moved epoch would have been fenced
+        stale = cli.confirm_gang("L1", "g1", _pb_spec(), fencing_epoch=99)
+        assert not stale.ok and "no such lease" in stale.error
+        # release of a consumed lease is a clean no-op
+        assert not cli.release_lease("L1").ok
+    finally:
+        if cli is not None:
+            cli.close()
+        for s in servers.values():
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded-staleness query plane
+# ---------------------------------------------------------------------------
+
+class _StubFollower:
+    """Just the surface _staleness_guard/_durable_seq consume."""
+
+    def __init__(self, stale, applied_seq=7):
+        self._stale = stale
+        self.applied_seq = applied_seq
+
+    def staleness(self):
+        return self._stale
+
+
+def test_follower_refuses_read_past_max_staleness():
+    leader_sched = _shard_sched("s", ("batch",))
+    leader, lport = serve(leader_sched, tick_mode=True)
+    standby, sport = serve(_shard_sched("s", ("batch",)),
+                           tick_mode=True, standby=True,
+                           peer_address=f"127.0.0.1:{lport}")
+    standby.ha_follower = _StubFollower(10.0, applied_seq=7)
+    direct = ha = None
+    try:
+        direct = CtldClient(f"127.0.0.1:{sport}")
+        # legacy contract: max_staleness=0 reads whatever the replica has
+        reply = direct.query_jobs(max_staleness=0.0)
+        assert list(reply.jobs) == [] and reply.durable_seq == 7
+        stale0 = REGISTRY.counter(
+            "crane_fed_stale_reads_refused_total").value()
+        with pytest.raises(grpc.RpcError) as ei:
+            direct.query_jobs(max_staleness=0.5)
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "exceeds max_staleness" in (ei.value.details() or "")
+        assert REGISTRY.counter(
+            "crane_fed_stale_reads_refused_total").value() == stale0 + 1
+        # every read verb honours the bound
+        for call in (direct.query_cluster, direct.query_stats,
+                     direct.query_events):
+            with pytest.raises(grpc.RpcError) as ei:
+                call(max_staleness=0.5)
+            assert ei.value.code() == \
+                grpc.StatusCode.FAILED_PRECONDITION
+        # a caught-up follower serves within the bound and stamps the
+        # durability watermark its answer reflects
+        standby.ha_follower = _StubFollower(0.1, applied_seq=42)
+        assert direct.query_jobs(max_staleness=5.0).durable_seq == 42
+        # the rotation contract: a refusing follower falls through to
+        # the leader, which always passes
+        standby.ha_follower = _StubFollower(10.0)
+        ha = HaCtldClient([f"127.0.0.1:{sport}",
+                           f"127.0.0.1:{lport}"])
+        assert list(ha.query_jobs(max_staleness=0.5).jobs) == []
+    finally:
+        for c in (direct, ha):
+            if c is not None:
+                c.close()
+        standby.stop()
+        leader.stop()
+
+
+# ---------------------------------------------------------------------------
+# two-phase gang commit under shard failure
+# ---------------------------------------------------------------------------
+
+def _gang_spec(cpu=1.0, runtime=5.0):
+    return JobSpec(user="u", res=ResourceSpec(cpu=cpu,
+                                              mem_bytes=1 << 30,
+                                              memsw_bytes=1 << 30),
+                   sim_runtime=runtime)
+
+
+def test_shard_crash_mid_reserve_releases_and_never_double_places(
+        tmp_path):
+    """SIGKILL one shard after its durable fed_reserve but before any
+    confirm: the arbiter aborts, the restarted shard's recovery drops
+    the bare reserve, and the re-placed gang lands each member exactly
+    once — zero lost, zero doubled."""
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          wal_dir=str(tmp_path))
+    fc.shards["east"].crash_after_lease = True
+    fc.submit_gang(GangRequest(name="g1", node_num=4,
+                               partitions=("batch", "gpu"),
+                               spec=_gang_spec()))
+    for _ in range(3):
+        fc.tick()
+    assert not fc.shards["east"].alive
+    assert fc.arbiter.stats["aborts"] >= 1
+    assert fc.arbiter.stats["commits"] == 0
+    fc.recover("east")
+    # recovery found the reserve-without-confirm and dropped it
+    assert not fc.shards["east"].fed.leases
+    fc.run_until_drained()
+    assert fc.arbiter.stats["commits"] == 1
+    led = fc.ledger()
+    assert led["lost"] == 0 and led["doubled"] == 0
+    members = sorted(
+        j.spec.name
+        for s in fc.shards.values()
+        for j in s.scheduler.history.values()
+        if j.spec.name.startswith("g1@"))
+    assert members == ["g1@batch", "g1@gpu"]
+
+
+def test_lease_ttl_expires_without_arbiter(tmp_path):
+    """A dead arbiter never strands capacity: the shard self-expires
+    the lease at its TTL and the nodes return to local scheduling."""
+    shard = SimShard("east", {"batch": 2},
+                     wal_path=str(tmp_path / "east.wal"))
+    req = _gang_spec().res.encode(shard.meta.layout)
+    names, _epoch, _seq = shard.fed.lease_nodes(
+        "L1", "batch", 2, req, ttl=10.0, now=0.0)
+    assert all(not shard.meta.node_by_name(n).schedulable
+               for n in names)
+    shard.tick(5.0)
+    assert "L1" in shard.fed.leases
+    shard.tick(11.0)  # past the deadline: tick() expires it
+    assert "L1" not in shard.fed.leases
+    assert all(shard.meta.node_by_name(n).schedulable for n in names)
+
+
+# ---------------------------------------------------------------------------
+# single-controller vs. federated parity (the replay oracle)
+# ---------------------------------------------------------------------------
+
+def _suffix(meta, nid):
+    """Node name minus the shard prefix: comparable across builds."""
+    return meta.nodes[nid].name.split("-", 1)[1]
+
+
+def _placements(sched):
+    out = {}
+    for job in list(sched.history.values()) + list(
+            sched.running.values()):
+        out[job.spec.name] = (
+            job.start_time,
+            tuple(sorted(_suffix(sched.meta, n)
+                         for n in job.node_ids)))
+    return out
+
+
+def test_single_vs_federated_parity():
+    """Disjoint partitions mean sharding must not change placement: a
+    2-shard federation and one controller over the union produce
+    bit-identical (start time, node set) per job."""
+    layout = {"batch": 3, "gpu": 2}
+    specs = []
+    for i in range(24):
+        part = "gpu" if i % 3 == 0 else "batch"
+        specs.append(JobSpec(
+            name=f"j{i:03d}", user="u", partition=part,
+            res=ResourceSpec(cpu=2.0 + (i % 3) * 2.0,
+                             mem_bytes=1 << 30, memsw_bytes=1 << 30),
+            sim_runtime=3.0 + (i % 5)))
+
+    solo = SimShard("solo", layout)
+    for spec in specs:
+        solo.submit(spec, 0.0)
+    now = 0.0
+    while now < 1000.0 and not solo.drained():
+        now += 1.0
+        solo.tick(now)
+    assert solo.drained()
+
+    fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 2}})
+    for spec in specs:
+        fc.submit(spec, 0.0)
+    fc.run_until_drained(max_cycles=1000)
+
+    fed = {}
+    for shard in fc.shards.values():
+        fed.update(_placements(shard.scheduler))
+    assert fed == _placements(solo.scheduler)
+    assert len(fed) == len(specs)

@@ -202,6 +202,89 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
     n += _add_field(_msg(fd, "QueryJobSummaryReply"), "explain_json", 4,
                     F.TYPE_STRING)
 
+    # federated control plane (fed/, ISSUE 15): shard-aware submit
+    # routing, the arbiter's two-phase lease/confirm channel, and the
+    # bounded-staleness read contract on the whole query surface
+    n += _add_field(_msg(fd, "SubmitJobRequest"), "forwarded", 2,
+                    F.TYPE_BOOL)
+    n += _add_field(_msg(fd, "SubmitJobReply"), "redirect_address", 3,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "SubmitJobReply"), "shard", 4,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "QueryJobsRequest"), "max_staleness", 7,
+                    F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "QueryJobsReply"), "durable_seq", 3,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "QueryJobsReply"), "shard", 4,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "QueryClusterRequest"), "max_staleness", 1,
+                    F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "QueryClusterReply"), "durable_seq", 2,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "QueryClusterReply"), "shard", 3,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "StatsRequest"), "max_staleness", 1,
+                    F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "StatsReply"), "durable_seq", 2,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "StatsReply"), "shard", 3, F.TYPE_STRING)
+    n += _add_field(_msg(fd, "QueryJobSummaryRequest"), "max_staleness",
+                    4, F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "QueryJobSummaryReply"), "durable_seq", 5,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "QueryJobSummaryReply"), "shard", 6,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "QueryEventsRequest"), "max_staleness", 6,
+                    F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "QueryEventsReply"), "durable_seq", 2,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "QueryEventsReply"), "shard", 3,
+                    F.TYPE_STRING)
+    n += _add_message(fd, "ShardInfo", [
+        ("name", 1, F.TYPE_STRING),
+        ("partitions", 2, F.TYPE_STRING, LABEL_REP),
+        ("address", 3, F.TYPE_STRING),
+        ("followers", 4, F.TYPE_STRING, LABEL_REP),
+    ])
+    n += _add_message(fd, "QueryShardMapRequest", [])
+    n += _add_message(fd, "QueryShardMapReply", [
+        ("shards", 1, F.TYPE_MESSAGE, LABEL_REP,
+         ".cranesched.ShardInfo"),
+        ("shard", 2, F.TYPE_STRING),
+        ("error", 3, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "LeaseNodesRequest", [
+        ("lease_id", 1, F.TYPE_STRING),
+        ("partition", 2, F.TYPE_STRING),
+        ("node_num", 3, F.TYPE_UINT32),
+        ("res", 4, F.TYPE_MESSAGE, LABEL_OPT, ".cranesched.ResourceSpec"),
+        ("ttl", 5, F.TYPE_DOUBLE),
+    ])
+    n += _add_message(fd, "LeaseNodesReply", [
+        ("ok", 1, F.TYPE_BOOL),
+        ("node_names", 2, F.TYPE_STRING, LABEL_REP),
+        ("fencing_epoch", 3, F.TYPE_UINT64),
+        ("durable_seq", 4, F.TYPE_UINT64),
+        ("error", 5, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "ConfirmGangRequest", [
+        ("lease_id", 1, F.TYPE_STRING),
+        ("gang_id", 2, F.TYPE_STRING),
+        ("spec", 3, F.TYPE_MESSAGE, LABEL_OPT, ".cranesched.JobSpec"),
+        ("node_names", 4, F.TYPE_STRING, LABEL_REP),
+        ("fencing_epoch", 5, F.TYPE_UINT64),
+    ])
+    n += _add_message(fd, "ConfirmGangReply", [
+        ("ok", 1, F.TYPE_BOOL),
+        ("job_id", 2, F.TYPE_UINT32),
+        ("durable_seq", 3, F.TYPE_UINT64),
+        ("error", 4, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "ReleaseLeaseRequest", [
+        ("lease_id", 1, F.TYPE_STRING),
+        ("fencing_epoch", 2, F.TYPE_UINT64),
+    ])
+
     # new CraneCtld methods (hand-glued handlers key off _RPCS, but the
     # descriptor stays the wire contract of record)
     n += _add_rpc(fd, "CraneCtld", "RequeueJob", "JobIdRequest",
@@ -218,6 +301,14 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
                   "QueryEventsReply")
     n += _add_rpc(fd, "CraneCtld", "CaptureProfile",
                   "CaptureProfileRequest", "CaptureProfileReply")
+    n += _add_rpc(fd, "CraneCtld", "QueryShardMap",
+                  "QueryShardMapRequest", "QueryShardMapReply")
+    n += _add_rpc(fd, "CraneCtld", "LeaseNodes", "LeaseNodesRequest",
+                  "LeaseNodesReply")
+    n += _add_rpc(fd, "CraneCtld", "ConfirmGang", "ConfirmGangRequest",
+                  "ConfirmGangReply")
+    n += _add_rpc(fd, "CraneCtld", "ReleaseLease", "ReleaseLeaseRequest",
+                  "OkReply")
     return n
 
 

@@ -103,3 +103,38 @@ print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"recompiles={ig['recompiles_per_cycle']} "
       f"solver={sc['solver']}")
 PY
+
+# federated control-plane smoke (ISSUE 15): two subprocess shards vs
+# one controller over the union, each saturated IN ISOLATION (one
+# server process at a time — the CI box may have a single core, and
+# concurrent shard processes would only time-slice it).  Asserts the
+# federation acceptance pair: 2-shard aggregate submit throughput at
+# least 2x the single controller, and query p99 under 50 ms against a
+# shard absorbing its own storm, plus an exactly-once arbiter ledger.
+fed=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import bench
+print(json.dumps(bench._measure_federation(
+    n_specs=2000, nodes_per_part=16)))
+PY
+)
+python - "$fed" <<'PY'
+import json
+import sys
+
+doc = json.loads(sys.argv[1])
+assert doc["speedup_ge_2x"], (
+    f"2-shard aggregate submit throughput is only "
+    f"{doc['submit_speedup']}x the single controller (limit >= 2x): "
+    f"single={doc['single']} federated={doc['federated']}")
+assert doc["query_p99_lt_50ms"], (
+    f"federated query p99 {doc['federated']['query_p99_ms']}ms over "
+    f"the 50ms budget: {doc['federated']}")
+assert doc["arbiter"]["ledger_ok"], (
+    f"federation drill lost or doubled work: {doc['arbiter']}")
+print(f"TIER1_FED_OK submit_speedup={doc['submit_speedup']} "
+      f"fed_query_p99_ms={doc['federated']['query_p99_ms']} "
+      f"single_submits_per_s={doc['single']['submits_per_s']} "
+      f"fed_submits_per_s={doc['federated']['submits_per_s']} "
+      f"arbiter_commits={doc['arbiter']['commits']}")
+PY
