@@ -37,6 +37,12 @@
 #              submit forwarding, the arbiter's two-phase gang commit
 #              under a mid-reserve shard crash, bounded-staleness read
 #              refusal, and bit-exact single-vs-federated parity.
+# tier1-flight — stall-forensics + federated-observability lane
+#              (@pytest.mark.flight in tests/test_flight.py): flight
+#              recorder ring/stall sentry, probe heartbeat protocol +
+#              forced-hang diagnosis, XLA cache wiring, federated span
+#              propagation, and the cluster SLO merge vs the
+#              single-controller oracle.
 # tier1-lint — metrics/docs parity (tools/check_metrics_docs.py):
 #              every registered crane_* metric has a row in the
 #              ARCHITECTURE.md metric inventory table and vice-versa.
@@ -50,7 +56,8 @@
 #              path.
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
-	tier1-delta tier1-resident tier1-trace tier1-fed tier1-lint
+	tier1-delta tier1-resident tier1-trace tier1-fed tier1-flight \
+	tier1-lint
 
 tier1: tier1-lint
 	bash tools/tier1.sh
@@ -95,4 +102,8 @@ tier1-trace:
 
 tier1-fed:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fed \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-flight:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m flight \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

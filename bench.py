@@ -32,59 +32,182 @@ BASELINE_DECISIONS_PER_SEC = 100_000.0
 DEFAULT_DEVICE_TIMEOUT_S = 420.0
 
 
+# the TPU probe child (obs/flight.py heartbeat protocol, ISSUE 16).
+# Deliberately stdlib-self-contained: importing cranesched_tpu here
+# could pull jax via package __init__s BEFORE the jax_import stamp,
+# which would blind the one phase the probe most suspects.  The stamp
+# marks the phase's START, fsync'd before proceeding, so on a hang the
+# last line on disk names the phase it died in.  BENCH_PROBE_INJECT_HANG
+# names a phase to wedge on purpose (the forensics self-test).
+_PROBE_SCRIPT = r"""
+import faulthandler, json, os, signal, sys, time
+
+hb_path, stack_path, cache_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+hb = open(hb_path, "a", encoding="utf-8")
+
+
+def stamp(phase):
+    hb.write(json.dumps({"t": time.time(), "phase": phase}) + "\n")
+    hb.flush()
+    os.fsync(hb.fileno())
+    if os.environ.get("BENCH_PROBE_INJECT_HANG", "") == phase:
+        time.sleep(3600.0)
+
+
+# the parent harvests this on timeout: SIGUSR1 -> all-thread tracebacks
+stack_fh = open(stack_path, "w", encoding="utf-8")
+faulthandler.register(signal.SIGUSR1, file=stack_fh, all_threads=True)
+
+stamp("jax_import")
+import jax
+
+cache = {"enabled": False, "hits": 0, "misses": 0, "error": ""}
+try:
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    import jax.monitoring as _mon
+
+    def _ev(event, **kw):
+        if event.endswith("cache_hits"):
+            cache["hits"] += 1
+        elif event.endswith("cache_misses"):
+            cache["misses"] += 1
+
+    _mon.register_event_listener(_ev)
+    cache["enabled"] = True
+except Exception as e:
+    cache["error"] = "%s: %s" % (type(e).__name__, e)
+
+stamp("backend_init")
+ds = jax.devices()
+stamp("first_trace")
+import jax.numpy as jnp
+
+x = jnp.arange(16.0)
+fn = jax.jit(lambda v: (v * 2.0 + 1.0).sum())
+lowered = fn.lower(x)
+stamp("first_compile")
+compiled = lowered.compile()
+stamp("first_execute")
+float(compiled(x))
+stamp("steady_state")
+float(fn(x))
+try:
+    cache["entries"] = sum(1 for f in os.listdir(cache_dir)
+                           if f.endswith("-cache"))
+except OSError:
+    cache["entries"] = 0
+print(json.dumps({"ok": True, "platform": ds[0].platform,
+                  "device_count": len(ds), "xla_cache": cache}))
+"""
+
+
 def _devices_with_timeout(timeout_s: float) -> dict:
     """TPU acquisition through this environment's tunnel can hang for
     many minutes; probe it ONCE in a subprocess with a hard budget and
     fall back to CPU so the bench always produces a number.
+
+    The probe stamps named phases (obs/flight.py PROBE_PHASES) into an
+    fsync'd heartbeat file, so a timeout is never bare: the diagnosis
+    names the phase it hung in and carries the child's faulthandler
+    stack dump (harvested via SIGUSR1 before the kill).  The persistent
+    XLA compilation cache under ``profiles/xla_cache/`` is enabled in
+    the child, with hit/miss counts reported on success — a warm cache
+    takes first_compile off the critical path across probe runs.
 
     Returns a diagnosis dict that lands in the output JSON — a CPU
     number must never masquerade as a TPU result without saying why
     (round-2 verdict: record the acquisition failure, don't silently
     benchmark CPU).  The diagnosis is built from THIS run's probe
     outcome, never from a remembered failure mode."""
+    import signal
     import subprocess
+    import tempfile
     import time as _time
 
+    from cranesched_tpu.obs.flight import PROBE_PHASES, read_heartbeat
+
+    workdir = tempfile.mkdtemp(prefix="crane-probe-")
+    hb_path = os.path.join(workdir, "heartbeat.jsonl")
+    stack_path = os.path.join(workdir, "stacks.txt")
+    cache_dir = os.environ.get(
+        "BENCH_XLA_CACHE_DIR", os.path.join("profiles", "xla_cache"))
     t0 = _time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _PROBE_SCRIPT,
+         hb_path, stack_path, cache_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    timed_out = False
     try:
-        probe = subprocess.run(
-            [sys.executable, "-u", "-c",
-             "import jax; ds = jax.devices(); "
-             "print('ok', ds[0].platform)"],
-            timeout=timeout_s, capture_output=True, text=True)
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        attempt = {"outcome": "timeout",
-                   "seconds": round(_time.monotonic() - t0, 1)}
-    else:
-        if probe.returncode == 0 and probe.stdout.startswith("ok"):
-            return {"acquired": True, "attempts": [
-                {"outcome": "ok",
-                 "seconds": round(_time.monotonic() - t0, 1)}]}
-        attempt = {
-            "outcome": f"rc={probe.returncode}",
-            "seconds": round(_time.monotonic() - t0, 1),
-            "tail": (probe.stderr or probe.stdout).strip()[-300:]}
-    # unreachable: force CPU before jax initializes in THIS process
+        timed_out = True
+        # harvest the child's stacks while it is still wedged: SIGUSR1
+        # fires its faulthandler dump, then the kill
+        try:
+            proc.send_signal(signal.SIGUSR1)
+            _time.sleep(2.0)
+        except Exception:
+            pass
+        proc.kill()
+        out, err = proc.communicate()
+    elapsed = round(_time.monotonic() - t0, 1)
+    beats = read_heartbeat(hb_path)
+    phases = [b["phase"] for b in beats]
+    if not timed_out and proc.returncode == 0:
+        doc = {}
+        try:
+            doc = json.loads(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            pass
+        if doc.get("ok"):
+            return {"acquired": True,
+                    "attempts": [{"outcome": "ok",
+                                  "seconds": elapsed}],
+                    "platform": doc.get("platform", ""),
+                    "phases": phases,
+                    "xla_cache": doc.get("xla_cache", {})}
+    try:
+        with open(stack_path, encoding="utf-8") as fh:
+            stacks = fh.read().strip()
+    except OSError:
+        stacks = ""
     configured = os.environ.get("JAX_PLATFORMS", "auto")
+    # unreachable: force CPU before jax initializes in THIS process
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    if attempt["outcome"] == "timeout":
+    if timed_out:
+        last = phases[-1] if phases else "(no heartbeat — died pre-stamp)"
+        pos = (f"{PROBE_PHASES.index(last) + 1}/{len(PROBE_PHASES)}"
+               if last in PROBE_PHASES else "?")
+        attempt = {"outcome": "timeout", "seconds": elapsed,
+                   "last_phase": last, "phases": phases}
         diagnosis = (
-            f"jax.devices() on the configured platform ({configured!r}) "
-            f"did not return within the {timeout_s:.0f} s probe budget "
-            "(backend initialization hung).  Falling back to CPU so the "
-            "bench still yields a number; the recorded device below is "
-            "therefore NOT a TPU.")
+            f"the TPU probe on platform {configured!r} hung in phase "
+            f"{last!r} ({pos} of the heartbeat protocol) and did not "
+            f"finish within the {timeout_s:.0f} s budget; "
+            f"{'an all-thread stack dump was captured' if stacks else 'no stack dump could be harvested'}. "
+            "Falling back to CPU so the bench still yields a number; "
+            "the recorded device below is therefore NOT a TPU.")
     else:
+        attempt = {
+            "outcome": f"rc={proc.returncode}", "seconds": elapsed,
+            "phases": phases,
+            "tail": ((err or out) or "").strip()[-300:]}
         diagnosis = (
             f"the device probe on platform {configured!r} exited with "
-            f"{attempt['outcome']} after {attempt['seconds']} s "
-            f"({attempt.get('tail', '')!r}).  Falling back to CPU so the "
-            "bench still yields a number; the recorded device below is "
+            f"{attempt['outcome']} after {elapsed} s having reached "
+            f"phase {phases[-1] if phases else '(none)'!r} "
+            f"({attempt['tail']!r}).  Falling back to CPU so the bench "
+            "still yields a number; the recorded device below is "
             "therefore NOT a TPU.")
     return {"acquired": False, "attempts": [attempt],
-            "diagnosis": diagnosis}
+            "diagnosis": diagnosis, "phases": phases,
+            "last_phase": phases[-1] if phases else "",
+            "stacks": stacks[-4000:]}
 
 
 def _build_sched(num_jobs: int, num_nodes: int, wal_dir=None):
@@ -285,7 +408,7 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         k = max(int(len(sched.pending) * churn), 1)
         preludes, totals, dirty = [], [], []
         h2d_bytes, h2d_rows, dirty_nodes, modes = [], [], [], []
-        trace_ms, recompiles = [], []
+        trace_ms, recompiles, flight_ms = [], [], []
         from cranesched_tpu.obs import introspect
         introspect_s0 = introspect.self_time_s()
         now = 3.0
@@ -297,7 +420,9 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
                 sched.submit(spec(), now=now)
             ts0 = (sched.jobtrace.self_time_s
                    if sched.jobtrace is not None else 0.0)
+            fs0 = sched.flight.self_time_s
             sched.schedule_cycle(now=now + 0.5)
+            flight_ms.append((sched.flight.self_time_s - fs0) * 1e3)
             if sched.jobtrace is not None:
                 trace_ms.append(
                     (sched.jobtrace.self_time_s - ts0) * 1e3)
@@ -338,9 +463,20 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
                                - skipped0),
             "trace_ms": round(float(np.median(trace_ms)), 4)
             if trace_ms else 0.0,
+            "flight_ms": round(float(np.median(flight_ms)), 4)
+            if flight_ms else 0.0,
             "recompiles": recompiles,
             "introspect_ms": round(introspect_ms, 4),
         }
+
+    # persistent XLA compilation cache (ISSUE 16): route this process's
+    # compiles through profiles/xla_cache/ and report the hit rate —
+    # warm runs of the same bench shapes should hit, proving the cache
+    # the TPU probe relies on actually works across processes
+    from cranesched_tpu.obs.flight import (
+        enable_xla_cache, xla_cache_stats)
+    xla_enabled = enable_xla_cache()
+    xla0 = xla_cache_stats()
 
     inc = run(True)
     base = run(False)
@@ -362,6 +498,28 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
     }
     tracing["overhead_ok"] = bool(
         tracing["trace_overhead_share"] <= 0.02)
+    # flight-recorder leg (ISSUE 16): the always-on phase ring stamps
+    # ~6 entries per cycle inside schedule_cycle — its accumulated
+    # self-time must stay <= 1% of the churn cycle wall time (same
+    # direct self-time measurement as the tracing leg).  The XLA cache
+    # stats ride along so tier1_perf can assert the hit rate is
+    # reported (and a warm second run shows hits > 0).
+    xla1 = xla_cache_stats()
+    flight = {
+        "flight_ms_per_cycle": inc["flight_ms"],
+        "flight_overhead_share": round(inc["flight_ms"] / on_ms, 4),
+        "xla_cache": {
+            "enabled": bool(xla_enabled),
+            "dir": xla1["dir"],
+            "hits": xla1["hits"] - xla0["hits"],
+            "misses": xla1["misses"] - xla0["misses"],
+            "entries": xla1["entries"],
+            "hit_rate": xla1["hit_rate"],
+            "error": xla1["error"],
+        },
+    }
+    flight["overhead_ok"] = bool(
+        flight["flight_overhead_share"] <= 0.01)
     # introspection-plane leg (ISSUE 14): warm churn cycles must pay
     # ZERO fresh jit compiles (the bucketed-padding contract, now
     # measured rather than assumed), and the observer probes + device
@@ -418,7 +576,7 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         "cycles": cycles,
         "incremental": inc, "full_rebuild": base,
         "resident": resident, "tracing": tracing,
-        "introspection": introspection,
+        "introspection": introspection, "flight": flight,
         # same seed + same event stream: identical first-wave placement
         # is the in-bench parity check (the real oracle lives in
         # tests/test_delta_cycle.py)

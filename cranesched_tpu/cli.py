@@ -767,15 +767,116 @@ def _cstats_stalled(doc) -> str | None:
     return None
 
 
+def _slo_table_rows(tag: str, table) -> list:
+    """One shard's (or the merged CLUSTER's) SLO table -> display rows
+    under a leading SHARD column, same shape as the cqueue merge."""
+    out = []
+    for slo in table or ():
+        for win, w in sorted(slo.get("windows", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            out.append((
+                tag, slo.get("name"),
+                f"{slo.get('from')}->{slo.get('to')}",
+                f"p{slo.get('p'):g}<={slo.get('target_seconds')}s",
+                f"{int(win)}s", w.get("count"),
+                round(float(w.get("observed", 0.0)), 4),
+                w.get("burn_rate"),
+                "BREACH" if w.get("breaching") else "ok"))
+    return out
+
+
 def cmd_cstats(args) -> int:
     import json as _json
     if getattr(args, "federation", False):
         fed = _fed_connect(args)
         if fed is None:
             return 1
+        if getattr(args, "job", 0):
+            # the owner shard is whichever one recorded the timeline —
+            # fan the summary out and render EVERY hit: shards number
+            # jobs independently, so one id can name different jobs on
+            # different shards (a forwarded submit's waterfall lives on
+            # the owner, not the shard the client happened to dial)
+            res = fed.summary(max_staleness=args.max_staleness,
+                              job_id=args.job)
+            hits = 0
+            for shard, reply in res:
+                if reply.timeline_json:
+                    from cranesched_tpu.obs.jobtrace import \
+                        render_waterfall
+                    hits += 1
+                    print(f"# shard {shard}")
+                    for line in render_waterfall(
+                            _json.loads(reply.timeline_json)):
+                        print(line)
+            fed.close()
+            if not hits:
+                print(f"no timeline recorded for job {args.job} on "
+                      f"any shard", file=sys.stderr)
+                return 1
+            return 0
         res = fed.stats(max_staleness=args.max_staleness)
-        doc = {shard: _json.loads(reply.json)
-               for shard, reply in res}
+        shard_docs = {}
+        for shard, reply in res:
+            try:
+                shard_docs[shard] = _json.loads(reply.json)
+            except ValueError:
+                res.errors[shard] = "unparseable stats reply"
+        if getattr(args, "slo", False):
+            # satellite fix (ISSUE 16): --federation used to dump the
+            # raw per-shard JSON and silently drop --slo.  Now: each
+            # shard's burn-rate rows shard-labeled like cqueue, plus
+            # the exact CLUSTER merge (obs/fedobs.py) the storm drills
+            # assert on.
+            from cranesched_tpu.obs.fedobs import merge_slo_tables
+            tables = {s: d.get("slo") or [] for s, d in
+                      shard_docs.items() if d.get("slo") is not None}
+            if not any(tables.values()):
+                print("no SLOs configured on any shard "
+                      "(Observability: SLO: in the cluster YAML)",
+                      file=sys.stderr)
+                fed.close()
+                return 1
+            rows = []
+            for shard in sorted(tables):
+                rows.extend(_slo_table_rows(shard, tables[shard]))
+            rows.extend(_slo_table_rows("CLUSTER",
+                                        merge_slo_tables(tables)))
+            print(_fmt_table(rows, ("SHARD", "SLO", "EDGE", "TARGET",
+                                    "WINDOW", "COUNT", "OBSERVED",
+                                    "BURN", "STATE")))
+            _fed_footer(res)
+            fed.close()
+            return 1 if res.errors else 0
+        prefix = getattr(args, "metrics", None)
+        if prefix is not None:
+            # cluster-wide scrape: counters/histograms summed across
+            # shards, gauges kept per-shard under a shard= label
+            from cranesched_tpu.obs.fedobs import merge_metric_snapshots
+            merged = merge_metric_snapshots(
+                {s: d.get("metrics") or {} for s, d in
+                 shard_docs.items()})
+            rows = []
+            for name, m in sorted(merged.items()):
+                if not name.startswith(prefix):
+                    continue
+                for labels, v in sorted(m.get("values", {}).items()):
+                    if isinstance(v, dict):
+                        val = (f"count={v.get('count')} sum="
+                               f"{round(float(v.get('sum', 0.0)), 6)}")
+                    else:
+                        val = v
+                    rows.append((name + labels, m.get("type"), val))
+            if not rows and prefix:
+                print(f"no metric family starts with {prefix!r}",
+                      file=sys.stderr)
+                fed.close()
+                return 1
+            print(_fmt_table(rows, ("METRIC", "TYPE", "VALUE")))
+            _fed_footer(res)
+            fed.close()
+            return 1 if res.errors else 0
+        doc = dict(shard_docs)
         for shard, sub in doc.items():
             sub["_durable_seq"] = getattr(
                 res.replies[shard], "durable_seq", 0)
@@ -966,6 +1067,99 @@ def cmd_cprofile(args) -> int:
         return 1
     print(f"profiling armed for {args.cycles} cycle(s) -> {reply.dir}")
     return 0
+
+
+def _render_flight(fl: dict, tail: int = 32) -> list[str]:
+    """Flight-recorder report -> display lines: recent phase timeline,
+    then the last stall's ring tail + all-thread stacks."""
+    out = []
+    phases = (fl.get("phases") or [])[-tail:]
+    if phases:
+        t0 = phases[0].get("t", 0.0)
+        rows = [(f"{p.get('t', 0.0) - t0:+9.3f}s", p.get("phase"),
+                 p.get("detail") or "-") for p in phases]
+        out.append(_fmt_table(rows, ("T", "PHASE", "DETAIL")))
+    else:
+        out.append("(no phase stamps recorded)")
+    out.append(f"# stalls_total={fl.get('stalls_total', 0)} "
+               f"armed={fl.get('armed', False)} "
+               f"self_time_s={fl.get('self_time_s', 0.0)}")
+    stall = fl.get("last_stall")
+    if stall:
+        out.append(f"LAST STALL label={stall.get('label')!r} "
+                   f"t={stall.get('time')}")
+        for p in stall.get("phases") or ():
+            out.append(f"  phase {p.get('phase')} t={p.get('t')} "
+                       f"{p.get('detail', '')}")
+        for thread, frames in sorted(
+                (stall.get("stacks") or {}).items()):
+            out.append(f"  -- thread {thread}")
+            for frame in frames:
+                for ln in frame.splitlines():
+                    out.append("    " + ln)
+    return out
+
+
+def cmd_cflight(args) -> int:
+    """Stall forensics viewer: the flight recorder's recent cycle-phase
+    timeline plus the last stall's all-thread stack capture — from a
+    live ctld, every shard of a federation, or a BENCH_*.json probe
+    diagnosis (``--file``)."""
+    import json as _json
+    if getattr(args, "file", ""):
+        with open(args.file, encoding="utf-8") as fh:
+            doc = _json.load(fh)
+        # accept the probe dict itself, a bench.py output doc, or the
+        # committed BENCH_rNN.json wrapper ({"parsed": <bench doc>})
+        acq = doc if isinstance(doc, dict) else {}
+        for path in (("device_acquisition",),
+                     ("detail", "device_acquisition"),
+                     ("parsed", "detail", "device_acquisition")):
+            node = doc
+            for key in path:
+                node = node.get(key) if isinstance(node, dict) else None
+            if node:
+                acq = node
+                break
+        phases = acq.get("phases") or []
+        print(f"probe acquired={acq.get('acquired', '?')} "
+              f"phases={'->'.join(str(p) for p in phases) or '(none)'}")
+        if acq.get("diagnosis"):
+            print(f"diagnosis: {acq['diagnosis']}")
+        if acq.get("stacks"):
+            print("-- harvested probe stacks --")
+            print(acq["stacks"])
+        return 0 if acq.get("acquired") else 1
+    if getattr(args, "federation", False):
+        fed = _fed_connect(args)
+        if fed is None:
+            return 1
+        res = fed.stats(max_staleness=args.max_staleness)
+        rc = 1 if res.errors else 0
+        for shard, reply in res:
+            try:
+                fl = _json.loads(reply.json).get("flight") or {}
+            except ValueError:
+                res.errors[shard] = "unparseable stats reply"
+                rc = 1
+                continue
+            print(f"== shard {shard} ==")
+            for line in _render_flight(fl, tail=args.tail):
+                print(line)
+            if fl.get("last_stall"):
+                rc = max(rc, 2)
+        _fed_footer(res)
+        fed.close()
+        return rc
+    client = _client(args)
+    doc = _json.loads(client.query_stats(
+        max_staleness=getattr(args, "max_staleness", 0.0)).json)
+    fl = doc.get("flight") or {}
+    for line in _render_flight(fl, tail=args.tail):
+        print(line)
+    # a recorded stall is the signal the operator came for: nonzero
+    # exit so drills can assert "no stalls" without parsing the text
+    return 2 if fl.get("last_stall") else 0
 
 
 def cmd_ccontrol(args) -> int:
@@ -1429,6 +1623,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dir", default="",
                    help="output directory (default profiles/capture-*)")
     p.set_defaults(func=cmd_cprofile)
+
+    p = sub.add_parser("cflight",
+                       help="stall forensics: recent cycle-phase "
+                            "timeline + the last stall's thread stacks")
+    p.add_argument("--tail", type=int, default=32, metavar="N",
+                   help="phase stamps to show (newest N)")
+    p.add_argument("--file", default="", metavar="PATH",
+                   help="render a BENCH_*.json probe diagnosis instead "
+                        "of querying a server")
+    _fed_flags(p)
+    p.set_defaults(func=cmd_cflight)
 
     p = sub.add_parser("crequeue",
                        help="stop running jobs and requeue them")

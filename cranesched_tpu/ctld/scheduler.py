@@ -82,6 +82,7 @@ from cranesched_tpu.models.solver_time import (
 from cranesched_tpu.obs import REGISTRY as _OBS
 from cranesched_tpu.obs import introspect
 from cranesched_tpu.obs.events import EventLog
+from cranesched_tpu.obs.flight import FlightRecorder
 from cranesched_tpu.obs.jobtrace import JobTraceRecorder
 from cranesched_tpu.obs.slo import SloEngine
 from cranesched_tpu.obs.trace import CycleTraceRing, solve_span
@@ -611,6 +612,15 @@ class JobScheduler:
         self._cycle_compile_base = introspect.total_compiles()
         self.profiler_window = introspect.ProfilerWindow(
             event_sink=lambda type, sev, detail="": self.events.emit(
+                type, sev, detail=detail),
+            namespace=lambda: self.shard_name)
+        # stall forensics (obs/flight.py): always-on phase ring the
+        # cycle stamps (~6 appends/cycle), plus the stall sentry the
+        # server's cycle loop arms around every cycle — a wedged cycle
+        # lands with all-thread stacks in flight.last_stall instead of
+        # a silent hang
+        self.flight = FlightRecorder(
+            event_sink=lambda type, sev, detail="": self.events.emit(
                 type, sev, detail=detail))
         # the in-flight cycle's ``now``: the dispatch-ring drain runs
         # lock-released and stamps committed_durable/dispatched on the
@@ -860,6 +870,7 @@ class JobScheduler:
         self.stats["skipped_cycles"] = (
             self.stats.get("skipped_cycles", 0) + 1)
         _MET_SKIPS.inc(reason=reason)
+        self.flight.stamp("skip", detail=reason)
         ms = round((_time.perf_counter() - t0) * 1e3, 3)
         self.stats["last_cycle_walltime"] = _time.time()
         self.stats["last_cycle"] = {
@@ -2152,6 +2163,7 @@ class JobScheduler:
         # profiler capture window tick (cheap no-ops when idle)
         self._cycle_compile_base = introspect.total_compiles()
         self.profiler_window.tick()
+        self.flight.stamp("cycle_begin")
         self._wal_begin()
         try:
             started = yield from self._cycle_body(now)
@@ -2163,6 +2175,7 @@ class JobScheduler:
             # (drained inline here; the normal path drained lock-free)
             self._wal_flush()
             self._drain_dispatch_ring()
+            self.flight.stamp("cycle_end")
 
     def _wal_begin(self) -> None:
         if self.wal is not None:
@@ -2225,6 +2238,7 @@ class JobScheduler:
                             epoch=epoch)
                 trace.stamp(job.job_id, inc, "dispatched", t,
                             epoch=epoch)
+        self.flight.stamp("dispatch", detail=str(len(items)))
         if self.dispatch_batch is not None:
             self.dispatch_batch(items)
         else:
@@ -2275,6 +2289,7 @@ class JobScheduler:
         self.meta.purge_expired_reservations(now)
         self._materialize_array_children(now)
         t_prelude = _time.perf_counter()
+        self.flight.stamp("prelude")
 
         # no-op short-circuit: the drains above already ran (they are
         # the event sinks), so if no epoch moved since the last armed
@@ -2699,6 +2714,7 @@ class JobScheduler:
         import time as _time
         self.stats["jobs_started_total"] += len(started)
         _MET_STARTED.inc(len(started))
+        self.flight.stamp("commit", detail=str(len(started)))
         total_ms = (t_end - t0) * 1e3
         drain_ms = (t_prelude - t0) * 1e3
         # prelude = everything before the FIRST solve closure started

@@ -64,6 +64,7 @@ class Lease:
     epoch: int
     deadline: float
     seq: int = 0  # the fed_reserve record's WAL seq
+    reserved_at: float = 0.0  # when granted (the arbiter_reserve span)
 
 
 class FedShardPlane:
@@ -134,7 +135,8 @@ class FedShardPlane:
                 "node_names": names, "epoch": epoch,
                 "deadline": deadline})
         self.leases[lease_id] = Lease(lease_id, partition, list(chosen),
-                                      epoch, deadline, seq)
+                                      epoch, deadline, seq,
+                                      reserved_at=now)
         sched.events.emit(
             "fed_lease_granted", "info", time=now,
             detail=f"lease={lease_id} part={partition} "
@@ -218,6 +220,18 @@ class FedShardPlane:
                     "lease_id": lease_id, "gang_id": gang_id,
                     "job_id": job_id, "epoch": sched.fencing_epoch})
             if sched.jobtrace is not None:
+                # the arbiter's two-phase hop, spanned on the member's
+                # own timeline (sequenced BEFORE placed so the
+                # waterfall reads reserve -> confirm -> placed):
+                # arbiter_reserve at lease-grant time, arbiter_confirm
+                # now — their gap is the cross-shard coordination cost
+                sched.jobtrace.stamp(
+                    job_id, job.requeue_count, "arbiter_reserve",
+                    lease.reserved_at or now,
+                    epoch=lease.epoch)
+                sched.jobtrace.stamp(
+                    job_id, job.requeue_count, "arbiter_confirm", now,
+                    epoch=sched.fencing_epoch)
                 sched.jobtrace.stamp(job_id, job.requeue_count, "placed",
                                      now, epoch=sched.fencing_epoch)
             sched._trigger_dep_event(job)

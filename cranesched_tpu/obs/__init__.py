@@ -11,12 +11,36 @@ lifecycle tracing with SLOs, and the scheduler watchdog.
                    derived latency histograms / exemplars.
 - ``slo.py``       sliding-window p50/p99 targets over trace edges with
                    multi-window burn-rate gauges and a breach counter.
+- ``flight.py``    stall forensics: always-on flight recorder (phase
+                   ring + stall sentry with all-thread stack dumps),
+                   the fsync'd probe heartbeat protocol, and the
+                   persistent XLA compilation cache with hit/miss
+                   counters.
+- ``fedobs.py``    federation-wide merge: scatter-gather metric
+                   aggregation and the cluster-level SLO engine over
+                   per-shard summaries (exact burn-rate merge).
 
 See ARCHITECTURE.md ("Observability" and "Per-job tracing and SLOs")
 for the metric naming scheme and the timeline schema.
 """
 
+from cranesched_tpu.obs.fedobs import (  # noqa: F401
+    ClusterSlo,
+    cluster_doc,
+    merge_metric_snapshots,
+    merge_slo_tables,
+)
+from cranesched_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    Heartbeat,
+    PROBE_PHASES,
+    dump_all_stacks,
+    enable_xla_cache,
+    read_heartbeat,
+    xla_cache_stats,
+)
 from cranesched_tpu.obs.jobtrace import (  # noqa: F401
+    FED_EDGES,
     SPAN_EDGES,
     JobTraceRecorder,
     render_waterfall,

@@ -47,6 +47,8 @@ docs assert on lives in :data:`EVENT_TYPES`:
     fed_forward (info)        a misrouted submit was forwarded
     fed_arbiter_commit (info) a cross-partition gang fully confirmed
     fed_arbiter_abort (warning) a partially-confirmed gang was undone
+    flight_stall (error)      the flight-recorder stall sentry fired
+                              (cycle deadline passed; stacks captured)
     cgroup_adopt_fallback (warning) PAM adoption granted access without
                               cgroup containment (cgroupfs unavailable)
 """
@@ -72,6 +74,9 @@ EVENT_TYPES = frozenset({
     # misrouted-submit forwarding, arbiter two-phase outcomes
     "fed_lease_granted", "fed_lease_revoked", "fed_forward",
     "fed_arbiter_commit", "fed_arbiter_abort",
+    # stall forensics (obs/flight.py): the armed cycle deadline passed
+    # and the sentry captured all-thread stacks into last_stall
+    "flight_stall",
     # craned PAM adoption fell back past cgroup containment (the
     # best-effort gap in craned/daemon.py, surfaced so drills can
     # assert on it instead of grepping logs)

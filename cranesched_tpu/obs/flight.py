@@ -1,0 +1,341 @@
+"""Stall forensics: the always-on flight recorder, the probe heartbeat
+protocol, and the persistent XLA compilation cache wiring.
+
+Four benches in a row (r06-r09) died the same way: the TPU probe timed
+out and left ZERO forensics — "jax.devices() did not return within the
+budget" names neither the phase that hung (import? backend init? first
+compile?) nor the stack it hung on.  This module makes every stall —
+probe-side or cycle-side — land with a phase attribution and an
+all-thread stack dump:
+
+* :class:`FlightRecorder` — a bounded ring of recent phase stamps (the
+  scheduler stamps cycle_begin/prelude/commit/dispatch/cycle_end per
+  cycle; ~6 appends, microseconds) plus a stall sentry the cycle loop
+  arms around every cycle.  If the deadline passes while armed, the
+  sentry captures ``sys._current_frames()`` for every thread into
+  ``last_stall`` alongside the ring tail — the "what was the scheduler
+  doing when it stopped" answer, without attaching a debugger to a
+  wedged daemon.  All bookkeeping self-time is accumulated so the bench
+  can prove the recorder costs <= 1% of a cycle.
+
+* The heartbeat protocol — :class:`Heartbeat` writes one fsync'd JSON
+  line per named phase (``PROBE_PHASES``: jax import -> backend init ->
+  first trace -> first compile -> first execute -> steady state);
+  :func:`read_heartbeat` parses the file tolerantly (a probe killed
+  mid-write leaves a torn last line, which is dropped, never raised
+  on).  bench.py's TPU probe subprocess stamps these so the parent's
+  timeout handler can say WHICH phase hung and harvest the child's
+  ``faulthandler`` stack dump into the BENCH_*.json diagnosis.
+
+* :func:`enable_xla_cache` — points ``jax_compilation_cache_dir`` at a
+  persistent directory (default ``profiles/xla_cache/``) with the size
+  and compile-time floors dropped so every executable is cached, and
+  registers a ``jax.monitoring`` listener that counts
+  ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` into
+  ``crane_xla_cache_*``.  A hung first-compile is the leading stall
+  suspect; a warm cache across probe runs removes the compile from the
+  critical path entirely — and the hit/miss counters prove whether it
+  actually did.
+
+jax is imported only inside :func:`enable_xla_cache` — the recorder and
+heartbeat halves must work in processes that are themselves trying to
+find out whether importing jax hangs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Optional
+
+from cranesched_tpu.obs.metrics import REGISTRY as _OBS
+
+#: the probe subprocess's named phases, in order.  A stamp marks the
+#: phase's START — on a timeout, the last stamp names where it hung.
+PROBE_PHASES = ("jax_import", "backend_init", "first_trace",
+                "first_compile", "first_execute", "steady_state")
+
+_MET_STAMPS = _OBS.counter(
+    "crane_flight_stamps_total",
+    "phase stamps appended to the flight-recorder ring")
+_MET_STALLS = _OBS.counter(
+    "crane_flight_stalls_total",
+    "stall-sentry firings (armed deadline passed; stacks captured)")
+_MET_XLA_HITS = _OBS.counter(
+    "crane_xla_cache_hits_total",
+    "persistent XLA compilation cache hits")
+_MET_XLA_MISSES = _OBS.counter(
+    "crane_xla_cache_misses_total",
+    "persistent XLA compilation cache misses (fresh compiles cached)")
+_MET_XLA_ENTRIES = _OBS.gauge(
+    "crane_xla_cache_entries",
+    "executables in the persistent XLA cache directory")
+
+_STAMPS_CELL = _MET_STAMPS.labels()
+
+
+def dump_all_stacks() -> dict[str, list[str]]:
+    """Formatted stack of every live thread, keyed ``name (tid)``.
+
+    Pure-Python ``sys._current_frames`` — works on a RUNNING process
+    (the sentry's case), unlike ``faulthandler`` which wants a file and
+    C-level signal safety (the probe child's case)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: dict[str, list[str]] = {}
+    for tid, frame in sys._current_frames().items():
+        key = f"{names.get(tid, '?')} ({tid})"
+        out[key] = [ln.rstrip("\n")
+                    for ln in traceback.format_stack(frame)]
+    return out
+
+
+class FlightRecorder:
+    """Bounded ring of phase stamps + an armable stall sentry.
+
+    The scheduler owns one instance and stamps its cycle phases; the
+    server's cycle loop arms the sentry before each cycle and disarms
+    after.  A deadline that passes while armed fires ONCE: the sentry
+    snapshots every thread's stack plus the ring tail into
+    :attr:`last_stall`, bumps ``crane_flight_stalls_total``, emits a
+    ``flight_stall`` event through ``event_sink``, and disarms (the
+    next cycle re-arms).  Nothing here ever raises into the loop."""
+
+    def __init__(self, capacity: int = 256,
+                 event_sink: Optional[Callable] = None):
+        self.capacity = max(int(capacity), 16)
+        self.event_sink = event_sink
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.self_time_s = 0.0
+        self.stalls_total = 0
+        self.last_stall: dict | None = None
+        # sentry state: deadline on the monotonic clock, None = disarmed
+        self._deadline: float | None = None
+        self._label = ""
+        self._cond = threading.Condition(self._lock)
+        self._sentry: threading.Thread | None = None
+        self._closed = False
+
+    # -- the hot path --
+
+    def stamp(self, phase: str, detail: str = "",
+              t: float | None = None) -> None:
+        """Append one phase stamp (wall time, phase, detail)."""
+        t0 = time.perf_counter()
+        rec = {"t": time.time() if t is None else t, "phase": phase}
+        if detail:
+            rec["detail"] = detail
+        with self._lock:
+            self._ring.append(rec)
+        _STAMPS_CELL.inc()
+        self.self_time_s += time.perf_counter() - t0
+
+    # -- the stall sentry --
+
+    def arm(self, timeout_s: float, label: str = "cycle") -> None:
+        """Start (or reset) the deadline; lazily spawns the sentry."""
+        if timeout_s <= 0:
+            return
+        with self._cond:
+            self._deadline = time.monotonic() + timeout_s
+            self._label = label
+            if self._sentry is None:
+                self._sentry = threading.Thread(
+                    target=self._sentry_loop, daemon=True,
+                    name="flight-sentry")
+                self._sentry.start()
+            self._cond.notify()
+
+    def disarm(self) -> None:
+        with self._cond:
+            self._deadline = None
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._deadline = None
+            self._cond.notify()
+
+    def _sentry_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                if self._deadline is None:
+                    self._cond.wait()
+                    continue
+                wait = self._deadline - time.monotonic()
+                if wait > 0:
+                    self._cond.wait(wait)
+                    continue
+                # expired while still armed: fire once and disarm
+                label = self._label
+                self._deadline = None
+            try:
+                self._record_stall(label)
+            except Exception:  # never kill the sentry
+                pass
+
+    def _record_stall(self, label: str) -> None:
+        stacks = dump_all_stacks()
+        with self._lock:
+            phases = list(self._ring)[-16:]
+        stall = {"time": time.time(), "label": label,
+                 "phases": phases, "stacks": stacks}
+        with self._lock:
+            self.last_stall = stall
+            self.stalls_total += 1
+        _MET_STALLS.inc()
+        if self.event_sink is not None:
+            last = phases[-1]["phase"] if phases else "(no stamps)"
+            self.event_sink(
+                "flight_stall", "error",
+                detail=f"{label} stalled; last phase {last}; "
+                       f"{len(stacks)} thread stacks captured")
+
+    # -- reading --
+
+    def report(self, tail: int = 64) -> dict:
+        """JSON-friendly dump for QueryStats / cflight."""
+        with self._lock:
+            return {"phases": list(self._ring)[-tail:],
+                    "stalls_total": self.stalls_total,
+                    "last_stall": self.last_stall,
+                    "self_time_s": round(self.self_time_s, 6),
+                    "armed": self._deadline is not None}
+
+
+# ---------------------------------------------------------------------------
+# the probe heartbeat protocol (bench.py TPU probe <-> parent)
+# ---------------------------------------------------------------------------
+
+
+class Heartbeat:
+    """fsync'd phase stamps: one JSON line per stamp, durable before
+    the writer proceeds — a probe killed mid-phase leaves its last
+    stamp on disk, which is the whole point."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def stamp(self, phase: str, detail: str = "") -> None:
+        rec = {"t": time.time(), "phase": phase}
+        if detail:
+            rec["detail"] = detail
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+def read_heartbeat(path: str) -> list[dict]:
+    """Parse a heartbeat file; missing file -> [], torn last line
+    dropped (the writer died mid-write — exactly the case this exists
+    for)."""
+    out: list[dict] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                if isinstance(rec, dict) and "phase" in rec:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache
+# ---------------------------------------------------------------------------
+
+_xla_lock = threading.Lock()
+_xla_state = {"enabled": False, "dir": "", "hits": 0, "misses": 0,
+              "error": ""}
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_cache_event(event: str, **kw) -> None:
+    if event == _HIT_EVENT:
+        with _xla_lock:
+            _xla_state["hits"] += 1
+        _MET_XLA_HITS.inc()
+    elif event == _MISS_EVENT:
+        with _xla_lock:
+            _xla_state["misses"] += 1
+        _MET_XLA_MISSES.inc()
+
+
+def enable_xla_cache(cache_dir: str = "") -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (default ``profiles/xla_cache/`` under the cwd) and start counting
+    hits/misses.  Idempotent; returns False (with the error recorded in
+    :func:`xla_cache_stats`) when jax is unavailable or too old —
+    callers degrade to uncached compiles, never crash."""
+    cache_dir = cache_dir or os.path.join("profiles", "xla_cache")
+    with _xla_lock:
+        if _xla_state["enabled"] and _xla_state["dir"] == cache_dir:
+            return True
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache EVERYTHING: the probe's first compile is exactly the
+        # small-and-fast executable the default floors would skip
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        import jax.monitoring as _mon
+        with _xla_lock:
+            if not _xla_state["enabled"]:
+                _mon.register_event_listener(_on_cache_event)
+            _xla_state["enabled"] = True
+            _xla_state["dir"] = cache_dir
+            _xla_state["error"] = ""
+        return True
+    except Exception as e:
+        with _xla_lock:
+            _xla_state["error"] = f"{type(e).__name__}: {e}"
+        return False
+
+
+def xla_cache_stats() -> dict:
+    """Hit/miss counters + on-disk entry count (JSON-friendly)."""
+    with _xla_lock:
+        st = dict(_xla_state)
+    entries = 0
+    if st["dir"]:
+        try:
+            entries = sum(1 for fn in os.listdir(st["dir"])
+                          if fn.endswith("-cache"))
+        except OSError:
+            entries = 0
+    _MET_XLA_ENTRIES.set(entries)
+    total = st["hits"] + st["misses"]
+    return {"enabled": st["enabled"], "dir": st["dir"],
+            "hits": st["hits"], "misses": st["misses"],
+            "entries": entries,
+            "hit_rate": round(st["hits"] / total, 4) if total else 0.0,
+            "error": st["error"]}

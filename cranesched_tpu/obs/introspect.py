@@ -185,16 +185,33 @@ class ProfilerWindow:
     :attr:`last_capture`.  Never raises into the cycle loop."""
 
     def __init__(self, base_dir: str = "profiles",
-                 event_sink: Optional[Callable] = None):
+                 event_sink: Optional[Callable] = None,
+                 namespace: "str | Callable[[], str] | None" = None):
         self.base_dir = base_dir
         self.event_sink = event_sink
+        # shard id (str, or callable resolved at request time — the
+        # scheduler learns its shard name AFTER construction when the
+        # fed plane attaches): federated shards often share one
+        # filesystem, and two shards arming in the same instant must
+        # not write traces into the same capture dir
+        self.namespace = namespace
         self._lock = threading.Lock()
         self._armed = 0          # cycles requested, 0 = disarmed
         self._remaining = 0      # cycles left in an active capture
         self._active_dir = ""
+        self._capture_seq = 0    # per-process uniquifier
         self.last_capture = ""
         self.last_error = ""
         self.captures_done = 0
+
+    def _namespace(self) -> str:
+        ns = self.namespace
+        if callable(ns):
+            try:
+                ns = ns()
+            except Exception:
+                ns = ""
+        return str(ns) if ns else ""
 
     def request(self, cycles: int, out_dir: str = "") -> tuple:
         """Arm a capture.  Returns (ok, dir-or-error)."""
@@ -204,8 +221,13 @@ class ProfilerWindow:
         with self._lock:
             if self._armed or self._remaining:
                 return False, "capture already in progress"
+            self._capture_seq += 1
+            ns = self._namespace()
+            tag = (f"capture-{ns}-" if ns else "capture-")
             d = out_dir or os.path.join(
-                self.base_dir, "capture-%d" % int(time.time() * 1000))
+                self.base_dir,
+                "%s%d-%d-%d" % (tag, int(time.time() * 1000),
+                                os.getpid(), self._capture_seq))
             self._armed = cycles
             self._active_dir = d
         return True, d

@@ -55,6 +55,14 @@ SPAN_EDGES = ("submit", "eligible", "placed", "committed_durable",
               "dispatched", "craned_received", "cgroup_ready",
               "step_start", "end", "requeue")
 
+#: federation spans (ISSUE 16) — stamped on the SAME (job_id,
+#: incarnation) key so a forwarded submit or an arbiter-placed gang
+#: keeps one unbroken waterfall across shard boundaries.  Kept out of
+#: SPAN_EDGES on purpose: they are optional interleavings, not part of
+#: the single-controller lifecycle schema the SLO engine and the
+#: happy-path tests assert on.
+FED_EDGES = ("fed_forwarded", "arbiter_reserve", "arbiter_confirm")
+
 _EDGE_ORDER = {e: i for i, e in enumerate(SPAN_EDGES)}
 _TERMINAL = ("end", "requeue")
 
@@ -73,8 +81,10 @@ _MET_SPILLED = REGISTRY.counter(
 # stamp() runs inside the scheduling cycle: pre-bind the per-edge
 # metric children so a hot-path observation never rebuilds its sorted
 # label-key tuple (metrics._BoundCell — ~5x cheaper per stamp)
-_LAT_CELLS = {e: _MET_LAT.labels(edge=e) for e in SPAN_EDGES}
-_EX_CELLS = {e: _MET_EXEMPLAR.labels(edge=e) for e in SPAN_EDGES}
+_LAT_CELLS = {e: _MET_LAT.labels(edge=e)
+              for e in SPAN_EDGES + FED_EDGES}
+_EX_CELLS = {e: _MET_EXEMPLAR.labels(edge=e)
+             for e in SPAN_EDGES + FED_EDGES}
 _STAMPS_CELL = _MET_STAMPS.labels()
 _SPILLED_CELL = _MET_SPILLED.labels()
 

@@ -41,12 +41,15 @@ class CtldClient:
 
     # ---- external ----
 
-    def submit(self, spec: pb.JobSpec,
-               forwarded: bool = False) -> pb.SubmitJobReply:
-        return self._call("SubmitBatchJob",
-                          pb.SubmitJobRequest(spec=spec,
-                                              forwarded=forwarded),
-                          pb.SubmitJobReply)
+    def submit(self, spec: pb.JobSpec, forwarded: bool = False,
+               forwarded_at: float = 0.0,
+               forwarded_from: str = "") -> pb.SubmitJobReply:
+        return self._call(
+            "SubmitBatchJob",
+            pb.SubmitJobRequest(spec=spec, forwarded=forwarded,
+                                forwarded_at=forwarded_at,
+                                forwarded_from=forwarded_from),
+            pb.SubmitJobReply)
 
     def submit_many(self, specs) -> pb.SubmitJobsReply:
         return self._call("SubmitBatchJobs",
@@ -413,8 +416,9 @@ class HaCtldClient(CtldClient):
             self._route_clients[address] = cli
         return cli
 
-    def submit(self, spec: pb.JobSpec,
-               forwarded: bool = False) -> pb.SubmitJobReply:
+    def submit(self, spec: pb.JobSpec, forwarded: bool = False,
+               forwarded_at: float = 0.0,
+               forwarded_from: str = "") -> pb.SubmitJobReply:
         """Route the submit to the partition's owning shard when the
         route is known; otherwise fall back to the HA rotation (the
         server forwards misrouted submits and answers with a redirect
@@ -422,7 +426,10 @@ class HaCtldClient(CtldClient):
         addr = self._shard_routes.get(spec.partition)
         if addr:
             try:
-                return self._route(addr).submit(spec, forwarded=forwarded)
+                return self._route(addr).submit(
+                    spec, forwarded=forwarded,
+                    forwarded_at=forwarded_at,
+                    forwarded_from=forwarded_from)
             except grpc.RpcError as e:
                 if e.code() not in _ROTATE_CODES:
                     raise
@@ -434,7 +441,9 @@ class HaCtldClient(CtldClient):
                         cli.close()
                     except Exception:
                         pass
-        reply = super().submit(spec, forwarded=forwarded)
+        reply = super().submit(spec, forwarded=forwarded,
+                               forwarded_at=forwarded_at,
+                               forwarded_from=forwarded_from)
         if reply.redirect_address:
             self._shard_routes[spec.partition] = reply.redirect_address
         return reply

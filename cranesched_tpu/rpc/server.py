@@ -248,8 +248,12 @@ class CtldServer:
                 error=f"partition {partition!r} belongs to shard "
                       f"{owner!r}")
         try:
-            reply = self._fed_client(address).submit(spec_pb,
-                                                     forwarded=True)
+            # trace context rides the forward: the owner stamps a
+            # fed_forwarded span at (when the hop left, from which
+            # shard) so the job's waterfall shows the boundary crossing
+            reply = self._fed_client(address).submit(
+                spec_pb, forwarded=True, forwarded_at=self._now(),
+                forwarded_from=self.shard_name)
         except grpc.RpcError as exc:
             # drop the cached channel: the next misroute redials
             cli = self._fwd_clients.pop(address, None)
@@ -283,8 +287,19 @@ class CtldServer:
         if owner is not None:
             return self._forward_submit(request.spec, spec.partition,
                                         *owner, request.forwarded)
+        now = self._now()
         with self._lock:
-            job_id = self.scheduler.submit(spec, now=self._now())
+            job_id = self.scheduler.submit(spec, now=now)
+            if (request.forwarded and job_id
+                    and self.scheduler.jobtrace is not None):
+                # span the shard hop on the fresh (job_id, 0) timeline:
+                # t = when the forward LEFT the misrouted shard, so the
+                # submit->fed_forwarded segment shows the hop latency
+                # (clocks are the federation's, skew rides as detail)
+                t_fwd = request.forwarded_at or now
+                self.scheduler.jobtrace.stamp(
+                    job_id, 0, "fed_forwarded", t_fwd,
+                    skew=round(now - t_fwd, 6))
         return pb.SubmitJobReply(
             job_id=job_id, error="" if job_id else "rejected",
             shard=self.shard_name)
@@ -704,6 +719,9 @@ class CtldServer:
                     self.scheduler.meta.snapshot()
                 free = alive_np & (avail_np == total_np).all(axis=1)
                 doc["topology"] = topology_doc(topo, free)
+            # stall forensics (cflight): recent phase ring + the last
+            # sentry-captured stall with its all-thread stacks
+            doc["flight"] = self.scheduler.flight.report()
             doc["watchdog"] = {
                 "now": time.time(),
                 "cycle_interval": self.cycle_interval,
@@ -1401,10 +1419,23 @@ class CtldServer:
             if self.ha_role != "leader":
                 continue  # standby: shadow state only, never schedule
             now = time.time()
+            # arm the stall sentry around the cycle: a cycle that
+            # neither finishes nor raises (a wedged solve, a stuck
+            # fsync) fires the flight recorder — all-thread stacks into
+            # flight.last_stall — instead of hanging silently.  The
+            # deadline mirrors the cstats staleness heuristic.
+            stall_after = max(3.0 * self.cycle_interval,
+                              2.0 * float(getattr(
+                                  self.scheduler.config,
+                                  "cycle_idle_sleep", 0.0)),
+                              5.0)
+            self.scheduler.flight.arm(stall_after, label="cycle")
             try:
                 self._cycle_once(now)
             except Exception:
                 self._record_cycle_crash(now)
+            finally:
+                self.scheduler.flight.disarm()
 
     def _sleep_interval(self) -> float:
         """Upper bound for the loop's event wait.  The base cadence
@@ -1475,7 +1506,9 @@ class CtldServer:
             st = self.scheduler.stats
             st["cycle_crashes_total"] = (
                 st.get("cycle_crashes_total", 0) + 1)
-            st["last_crash"] = {"time": now, "traceback": tb}
+            st["last_crash"] = {"time": now, "traceback": tb,
+                                "flight": self.scheduler.flight.report(
+                                    tail=16)}
             self.scheduler.events.emit(
                 "watchdog_crash", "error", time=now,
                 detail=tb.strip().rsplit("\n", 1)[-1][:200])
@@ -1483,6 +1516,7 @@ class CtldServer:
     def stop(self) -> None:
         self._stop.set()
         self._cycle_kick.set()  # wake a possibly long idle sleep
+        self.scheduler.flight.close()
         for cli in self._fwd_clients.values():
             try:
                 cli.close()

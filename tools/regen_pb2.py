@@ -207,6 +207,14 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
     # bounded-staleness read contract on the whole query surface
     n += _add_field(_msg(fd, "SubmitJobRequest"), "forwarded", 2,
                     F.TYPE_BOOL)
+    # federated trace context (ISSUE 16): the forwarding shard stamps
+    # when and from where it handed the submit off, so the owner can
+    # record the fed_forwarded span on the job's (job_id, incarnation)
+    # timeline — one unbroken waterfall across the shard boundary
+    n += _add_field(_msg(fd, "SubmitJobRequest"), "forwarded_at", 3,
+                    F.TYPE_DOUBLE)
+    n += _add_field(_msg(fd, "SubmitJobRequest"), "forwarded_from", 4,
+                    F.TYPE_STRING)
     n += _add_field(_msg(fd, "SubmitJobReply"), "redirect_address", 3,
                     F.TYPE_STRING)
     n += _add_field(_msg(fd, "SubmitJobReply"), "shard", 4,

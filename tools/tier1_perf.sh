@@ -91,6 +91,19 @@ assert ig["introspect_overhead_share"] <= 0.02, (
     f"of the churn cycle (limit 2%): {ig}")
 assert "recompiles" in sc and "device_buffers" in sc, (
     f"sched_cycle detail lost the introspection fields: {sc}")
+# flight-recorder guards (ISSUE 16): the always-on phase ring must cost
+# <=1% of the churn cycle wall time, and the churn leg must report the
+# persistent-XLA-cache hit rate (the probe's cross-run warm-compile
+# contract depends on the cache actually being wired)
+fg = ch["flight"]
+assert fg["flight_overhead_share"] <= 0.01, (
+    f"flight recorder added {fg['flight_overhead_share']:.1%} to the "
+    f"churn cycle (limit 1%): {fg}")
+xc = fg["xla_cache"]
+assert "hit_rate" in xc and "enabled" in xc, (
+    f"churn leg lost the XLA cache stats: {fg}")
+assert xc["enabled"] or xc["error"], (
+    f"XLA cache neither enabled nor diagnosed: {xc}")
 print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"lock_held_share={lock_share:.3f} "
       f"wal_fsyncs_per_cycle={sc['wal_fsyncs_per_cycle']} "
@@ -99,6 +112,8 @@ print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"resident_h2d_bytes={rs['h2d_bytes_per_cycle']} "
       f"patch_overlap_share={rs['patch_overlap_share']} "
       f"trace_overhead_share={tg['trace_overhead_share']} "
+      f"flight_share={fg['flight_overhead_share']} "
+      f"xla_cache_hit_rate={xc['hit_rate']} "
       f"introspect_share={ig['introspect_overhead_share']} "
       f"recompiles={ig['recompiles_per_cycle']} "
       f"solver={sc['solver']}")
