@@ -43,6 +43,12 @@
 #              forced-hang diagnosis, XLA cache wiring, federated span
 #              propagation, and the cluster SLO merge vs the
 #              single-controller oracle.
+# tier1-multihost — multi-process mesh solve lane
+#              (@pytest.mark.multihost in tests/test_multihost.py):
+#              2-rank hierarchical solve vs the single-process oracle
+#              (overlapping + disjoint class tables), real 2-process
+#              CPU-mesh smoke (XLA_FLAGS forced host devices), mesh
+#              bootstrap failure modes over the rendezvous.
 # tier1-lint — metrics/docs parity (tools/check_metrics_docs.py):
 #              every registered crane_* metric has a row in the
 #              ARCHITECTURE.md metric inventory table and vice-versa.
@@ -57,7 +63,7 @@
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
 	tier1-delta tier1-resident tier1-trace tier1-fed tier1-flight \
-	tier1-lint
+	tier1-multihost tier1-lint
 
 tier1: tier1-lint
 	bash tools/tier1.sh
@@ -106,4 +112,8 @@ tier1-fed:
 
 tier1-flight:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m flight \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-multihost:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m multihost \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

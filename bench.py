@@ -32,182 +32,31 @@ BASELINE_DECISIONS_PER_SEC = 100_000.0
 DEFAULT_DEVICE_TIMEOUT_S = 420.0
 
 
-# the TPU probe child (obs/flight.py heartbeat protocol, ISSUE 16).
-# Deliberately stdlib-self-contained: importing cranesched_tpu here
-# could pull jax via package __init__s BEFORE the jax_import stamp,
-# which would blind the one phase the probe most suspects.  The stamp
-# marks the phase's START, fsync'd before proceeding, so on a hang the
-# last line on disk names the phase it died in.  BENCH_PROBE_INJECT_HANG
-# names a phase to wedge on purpose (the forensics self-test).
-_PROBE_SCRIPT = r"""
-import faulthandler, json, os, signal, sys, time
-
-hb_path, stack_path, cache_dir = sys.argv[1], sys.argv[2], sys.argv[3]
-hb = open(hb_path, "a", encoding="utf-8")
-
-
-def stamp(phase):
-    hb.write(json.dumps({"t": time.time(), "phase": phase}) + "\n")
-    hb.flush()
-    os.fsync(hb.fileno())
-    if os.environ.get("BENCH_PROBE_INJECT_HANG", "") == phase:
-        time.sleep(3600.0)
-
-
-# the parent harvests this on timeout: SIGUSR1 -> all-thread tracebacks
-stack_fh = open(stack_path, "w", encoding="utf-8")
-faulthandler.register(signal.SIGUSR1, file=stack_fh, all_threads=True)
-
-stamp("jax_import")
-import jax
-
-cache = {"enabled": False, "hits": 0, "misses": 0, "error": ""}
-try:
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    import jax.monitoring as _mon
-
-    def _ev(event, **kw):
-        if event.endswith("cache_hits"):
-            cache["hits"] += 1
-        elif event.endswith("cache_misses"):
-            cache["misses"] += 1
-
-    _mon.register_event_listener(_ev)
-    cache["enabled"] = True
-except Exception as e:
-    cache["error"] = "%s: %s" % (type(e).__name__, e)
-
-stamp("backend_init")
-ds = jax.devices()
-stamp("first_trace")
-import jax.numpy as jnp
-
-x = jnp.arange(16.0)
-fn = jax.jit(lambda v: (v * 2.0 + 1.0).sum())
-lowered = fn.lower(x)
-stamp("first_compile")
-compiled = lowered.compile()
-stamp("first_execute")
-float(compiled(x))
-stamp("steady_state")
-float(fn(x))
-try:
-    cache["entries"] = sum(1 for f in os.listdir(cache_dir)
-                           if f.endswith("-cache"))
-except OSError:
-    cache["entries"] = 0
-print(json.dumps({"ok": True, "platform": ds[0].platform,
-                  "device_count": len(ds), "xla_cache": cache}))
-"""
-
-
 def _devices_with_timeout(timeout_s: float) -> dict:
     """TPU acquisition through this environment's tunnel can hang for
     many minutes; probe it ONCE in a subprocess with a hard budget and
     fall back to CPU so the bench always produces a number.
 
-    The probe stamps named phases (obs/flight.py PROBE_PHASES) into an
-    fsync'd heartbeat file, so a timeout is never bare: the diagnosis
-    names the phase it hung in and carries the child's faulthandler
-    stack dump (harvested via SIGUSR1 before the kill).  The persistent
-    XLA compilation cache under ``profiles/xla_cache/`` is enabled in
-    the child, with hit/miss counts reported on success — a warm cache
-    takes first_compile off the critical path across probe runs.
+    The probe is the hardened acquisition handshake from
+    parallel/acquire.py (env pre-flight -> jax import -> PJRT
+    backend init -> device enum, then the compile-warm phases), each
+    phase stamped into an fsync'd heartbeat file, so a timeout is never
+    bare: the diagnosis names the phase it hung in, carries the child's
+    faulthandler stack dump (harvested via SIGUSR1 before the kill),
+    and the env pre-flight report (libtpu path, TPU_* vars, chip
+    visibility) saying why the plugin had a chance to wedge.  The
+    persistent XLA compilation cache under ``profiles/xla_cache/`` is
+    enabled in the child, with hit/miss counts reported on success — a
+    warm cache takes first_compile off the critical path across runs.
 
     Returns a diagnosis dict that lands in the output JSON — a CPU
     number must never masquerade as a TPU result without saying why
     (round-2 verdict: record the acquisition failure, don't silently
     benchmark CPU).  The diagnosis is built from THIS run's probe
     outcome, never from a remembered failure mode."""
-    import signal
-    import subprocess
-    import tempfile
-    import time as _time
+    from cranesched_tpu.parallel.acquire import acquire_backend
 
-    from cranesched_tpu.obs.flight import PROBE_PHASES, read_heartbeat
-
-    workdir = tempfile.mkdtemp(prefix="crane-probe-")
-    hb_path = os.path.join(workdir, "heartbeat.jsonl")
-    stack_path = os.path.join(workdir, "stacks.txt")
-    cache_dir = os.environ.get(
-        "BENCH_XLA_CACHE_DIR", os.path.join("profiles", "xla_cache"))
-    t0 = _time.monotonic()
-    proc = subprocess.Popen(
-        [sys.executable, "-u", "-c", _PROBE_SCRIPT,
-         hb_path, stack_path, cache_dir],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    timed_out = False
-    try:
-        out, err = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        # harvest the child's stacks while it is still wedged: SIGUSR1
-        # fires its faulthandler dump, then the kill
-        try:
-            proc.send_signal(signal.SIGUSR1)
-            _time.sleep(2.0)
-        except Exception:
-            pass
-        proc.kill()
-        out, err = proc.communicate()
-    elapsed = round(_time.monotonic() - t0, 1)
-    beats = read_heartbeat(hb_path)
-    phases = [b["phase"] for b in beats]
-    if not timed_out and proc.returncode == 0:
-        doc = {}
-        try:
-            doc = json.loads(out.strip().splitlines()[-1])
-        except (ValueError, IndexError):
-            pass
-        if doc.get("ok"):
-            return {"acquired": True,
-                    "attempts": [{"outcome": "ok",
-                                  "seconds": elapsed}],
-                    "platform": doc.get("platform", ""),
-                    "phases": phases,
-                    "xla_cache": doc.get("xla_cache", {})}
-    try:
-        with open(stack_path, encoding="utf-8") as fh:
-            stacks = fh.read().strip()
-    except OSError:
-        stacks = ""
-    configured = os.environ.get("JAX_PLATFORMS", "auto")
-    # unreachable: force CPU before jax initializes in THIS process
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    if timed_out:
-        last = phases[-1] if phases else "(no heartbeat — died pre-stamp)"
-        pos = (f"{PROBE_PHASES.index(last) + 1}/{len(PROBE_PHASES)}"
-               if last in PROBE_PHASES else "?")
-        attempt = {"outcome": "timeout", "seconds": elapsed,
-                   "last_phase": last, "phases": phases}
-        diagnosis = (
-            f"the TPU probe on platform {configured!r} hung in phase "
-            f"{last!r} ({pos} of the heartbeat protocol) and did not "
-            f"finish within the {timeout_s:.0f} s budget; "
-            f"{'an all-thread stack dump was captured' if stacks else 'no stack dump could be harvested'}. "
-            "Falling back to CPU so the bench still yields a number; "
-            "the recorded device below is therefore NOT a TPU.")
-    else:
-        attempt = {
-            "outcome": f"rc={proc.returncode}", "seconds": elapsed,
-            "phases": phases,
-            "tail": ((err or out) or "").strip()[-300:]}
-        diagnosis = (
-            f"the device probe on platform {configured!r} exited with "
-            f"{attempt['outcome']} after {elapsed} s having reached "
-            f"phase {phases[-1] if phases else '(none)'!r} "
-            f"({attempt['tail']!r}).  Falling back to CPU so the bench "
-            "still yields a number; the recorded device below is "
-            "therefore NOT a TPU.")
-    return {"acquired": False, "attempts": [attempt],
-            "diagnosis": diagnosis, "phases": phases,
-            "last_phase": phases[-1] if phases else "",
-            "stacks": stacks[-4000:]}
+    return acquire_backend(timeout_s, warm=True)
 
 
 def _build_sched(num_jobs: int, num_nodes: int, wal_dir=None):
@@ -457,6 +306,7 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
             "resident_modes": modes,
             "full_rebuilds": int(res.full_rebuilds),
             "patch_cycles": int(res.patch_cycles),
+            "ledger_cycles": int(res.ledger_cycles),
             "patch_overlap_share": round(res.overlap_share(), 4),
             "idle_tick_ms": round(idle_ms, 3),
             "skipped_cycles": (sched.stats.get("skipped_cycles", 0)
@@ -544,12 +394,25 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
     from cranesched_tpu.ctld.resident import (
         full_state_bytes, padded_rows, patch_row_bytes)
     num_dims = res_on["num_dims"]
-    # independent dirty-rows bound: the rows the delta snapshot itself
-    # re-read this cycle (trace dirty_nodes) plus the full [N] cost
-    # seed — a silent full-rebuild regression blows straight past it
-    bound = (padded_rows(max(res_on["dirty_nodes"], 1), num_nodes)
-             * patch_row_bytes(num_dims) + 4 * num_nodes)
     steady = res_on["resident_modes"]
+    # BENCH_r10 anomaly (ISSUE 17): every steady churn cycle here has
+    # an EMPTY delta — nothing places in steady state, so no node row
+    # is dirtied and the only H2D traffic is the time-dependent [N]
+    # cost ledger (exactly 4*N bytes).  Those cycles used to report
+    # mode "patch", which read as patch traffic with dirty_nodes=0 and
+    # a speedup of ~1.0 against a bound derived from a phantom dirty
+    # row.  They now report mode "ledger", and an all-ledger steady
+    # state is held to the EXACT ledger size instead of the padded
+    # dirty-row formula.
+    ledger_only = bool(steady and all(m == "ledger" for m in steady))
+    if ledger_only:
+        bound = 4 * num_nodes
+    else:
+        # dirty-rows bound: the rows the delta snapshot itself re-read
+        # this cycle (trace dirty_nodes) plus the full [N] cost seed —
+        # a silent full-rebuild regression blows straight past it
+        bound = (padded_rows(max(res_on["dirty_nodes"], 1), num_nodes)
+                 * patch_row_bytes(num_dims) + 4 * num_nodes)
     resident = {
         "cycle_ms": res_on["total_ms"],
         "rebuild_cycle_ms": res_off["total_ms"],
@@ -561,10 +424,16 @@ def _measure_churn(num_jobs: int = 100_000, num_nodes: int = 512,
         "dirty_bound_bytes": int(bound),
         "full_state_bytes": int(
             full_state_bytes(num_nodes, num_dims)),
+        # "no steady cycle fell back to a rebuild" — ledger counts:
+        # it ships strictly less than a patch
         "steady_state_patch": bool(
-            steady and all(m == "patch" for m in steady)),
+            steady and all(m in ("patch", "ledger") for m in steady)),
+        "steady_state_ledger_only": ledger_only,
+        "steady_state_modes": {
+            m: steady.count(m) for m in sorted(set(steady))},
         "full_rebuilds": res_on["full_rebuilds"],
         "patch_cycles": res_on["patch_cycles"],
+        "ledger_cycles": res_on["ledger_cycles"],
         "patch_overlap_share": res_on["patch_overlap_share"],
         "placements_match": bool(
             res_on["first_cycle_started"]
@@ -937,6 +806,213 @@ def _measure_federation(n_specs: int = 4_000,
     }
 
 
+# one rank of the multi-host solve: loads the shared problem, slices
+# its node slab, bootstraps a ProcessMesh over the parent's rendezvous
+# (CRANE_RENDEZVOUS/_TOKEN env), runs the solve twice — cold (pays the
+# two per-shape jit compiles) and warm on a rebuilt slab state — and
+# reports the warm wall plus its fence share from the mesh histogram.
+_MULTIHOST_CHILD_SRC = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from cranesched_tpu.models.solver import make_cluster_state
+from cranesched_tpu.parallel.distributed import (
+    _MET_FENCE, bootstrap_process_mesh, solve_greedy_sharded_classes_mp)
+
+rank = int(os.environ["CRANE_MP_RANK"])
+nprocs = int(os.environ["CRANE_MP_NPROCS"])
+pb = dict(np.load(sys.argv[1]))
+max_nodes = int(pb.pop("max_nodes"))
+n = pb["avail"].shape[0]
+slab = n // nprocs
+lo, hi = rank * slab, (rank + 1) * slab
+jargs = [jnp.asarray(pb[k]) for k in
+         ("req", "node_num", "time_limit", "valid", "job_class")]
+cmask = jnp.asarray(pb["class_masks"][:, lo:hi])
+
+
+def slab_state():
+    return make_cluster_state(pb["avail"][lo:hi], pb["total"][lo:hi],
+                              pb["alive"][lo:hi], pb["cost"][lo:hi])
+
+
+def fence_totals():
+    return [sum(v[k] for v in _MET_FENCE.snapshot().values())
+            for k in ("count", "sum")]
+
+
+pmesh = bootstrap_process_mesh(rank, nprocs, slab)
+t0 = time.perf_counter()
+p, s = solve_greedy_sharded_classes_mp(
+    pmesh, slab_state(), *jargs, cmask, max_nodes=max_nodes)
+jax.block_until_ready((p.placed, s.avail))
+cold_s = time.perf_counter() - t0
+f0 = fence_totals()
+t0 = time.perf_counter()
+p, s = solve_greedy_sharded_classes_mp(
+    pmesh, slab_state(), *jargs, cmask, max_nodes=max_nodes)
+jax.block_until_ready((p.placed, s.avail))
+warm_s = time.perf_counter() - t0
+f1 = fence_totals()
+print(json.dumps({
+    "rank": rank, "mesh": pmesh.describe(),
+    "cold_s": round(cold_s, 4), "warm_s": round(warm_s, 4),
+    "fence_count": int(f1[0] - f0[0]),
+    "fence_s": round(f1[1] - f0[1], 4),
+    "placed": np.asarray(p.placed).tolist(),
+    "nodes": np.asarray(p.nodes).tolist(),
+    "reason": np.asarray(p.reason).tolist(),
+    "avail": np.asarray(s.avail).tolist()}), flush=True)
+pmesh.close()
+"""
+
+
+def _measure_multihost(num_jobs: int = 512, num_nodes: int = 256,
+                       num_classes: int = 8, nprocs: int = 2,
+                       local_devices: int = 4,
+                       max_nodes: int = 2) -> dict:
+    """First multi-host solve number (ISSUE 17): ``nprocs`` real OS
+    processes — separate jax runtimes with ``local_devices`` forced
+    host devices each, node slabs split between them — bootstrap over
+    a RendezvousServer and run the hierarchical
+    ``solve_greedy_sharded_classes_mp``.  The CI stand-in for a pod
+    slice: same code path, CPU devices, rendezvous on loopback.
+
+    Reports the warm per-cycle wall (max over ranks — the solve
+    completes when the slowest rank does), its host-fence share, and
+    asserts bit-exact parity against the single-process
+    ``solve_greedy_sharded_classes`` oracle computed in THIS process."""
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from cranesched_tpu.models.solver import make_cluster_state
+    from cranesched_tpu.ops.resources import ResourceLayout
+    from cranesched_tpu.parallel.sharded import (
+        make_node_mesh,
+        shard_cluster_state,
+        solve_greedy_sharded_classes,
+    )
+    from cranesched_tpu.rpc.rendezvous import RendezvousServer
+
+    num_nodes -= num_nodes % (nprocs * local_devices)  # even slabs
+    rng = np.random.default_rng(17)
+    lay = ResourceLayout()
+    total = np.stack([
+        lay.encode(cpu=int(rng.integers(8, 65)),
+                   mem_bytes=int(rng.integers(16, 257)) << 30,
+                   is_capacity=True)
+        for _ in range(num_nodes)])
+    used = np.stack([
+        lay.encode(cpu=float(rng.integers(0, 8)),
+                   mem_bytes=int(rng.integers(0, 8)) << 30)
+        for _ in range(num_nodes)])
+    pb = dict(
+        avail=total - np.minimum(used, total), total=total,
+        alive=rng.random(num_nodes) >= 0.05,
+        cost=rng.random(num_nodes).astype(np.float32) * 10,
+        req=np.stack([
+            lay.encode(cpu=float(rng.integers(1, 17)),
+                       mem_bytes=int(rng.integers(1, 33)) << 30)
+            for _ in range(num_jobs)]),
+        node_num=rng.integers(1, max_nodes + 1,
+                              size=num_jobs).astype(np.int32),
+        time_limit=rng.integers(60, 86400,
+                                size=num_jobs).astype(np.int32),
+        valid=(rng.random(num_jobs) > 0.05),
+        job_class=rng.integers(0, num_classes,
+                               size=num_jobs).astype(np.int32),
+        class_masks=(rng.random((num_classes, num_nodes)) > 0.25))
+
+    # single-process oracle over this process's own device mesh
+    mesh = make_node_mesh()
+    state = make_cluster_state(pb["avail"], pb["total"], pb["alive"],
+                               pb["cost"])
+    p_ref, s_ref = solve_greedy_sharded_classes(
+        shard_cluster_state(state, mesh), jnp.asarray(pb["req"]),
+        jnp.asarray(pb["node_num"]), jnp.asarray(pb["time_limit"]),
+        jnp.asarray(pb["valid"]), jnp.asarray(pb["job_class"]),
+        jnp.asarray(pb["class_masks"]), mesh, max_nodes=max_nodes)
+    jax.block_until_ready(p_ref.placed)
+
+    server = RendezvousServer(token="bench-mh", nranks=nprocs, epoch=1)
+    port = server.start("127.0.0.1:0")
+    procs, outs = [], []
+    with tempfile.TemporaryDirectory() as tmp:
+        npz = os.path.join(tmp, "problem.npz")
+        np.savez(npz, max_nodes=max_nodes, **pb)
+        try:
+            for rank in range(nprocs):
+                env = dict(os.environ)
+                # the children must never inherit an injected hang or
+                # a TPU library discovery — they are the CPU stand-in
+                env.pop("BENCH_ACQUIRE_INJECT_HANG", None)
+                env.pop("BENCH_PROBE_INJECT_HANG", None)
+                env.update({
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": ("--xla_force_host_platform_device_"
+                                  f"count={local_devices}"),
+                    "CRANE_RENDEZVOUS": f"127.0.0.1:{port}",
+                    "CRANE_RENDEZVOUS_TOKEN": "bench-mh",
+                    "CRANE_MP_RANK": str(rank),
+                    "CRANE_MP_NPROCS": str(nprocs),
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _MULTIHOST_CHILD_SRC, npz],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env))
+            for p in procs:
+                out, err = p.communicate(timeout=540)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"multihost rank died rc={p.returncode}: "
+                        f"{err[-2000:]}")
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+
+    # every rank computes the same global placements; they must match
+    # the single-process oracle bit for bit (the acceptance contract —
+    # a multi-host number for a DIFFERENT schedule would be worthless)
+    ref_placed = np.asarray(p_ref.placed).tolist()
+    ref_nodes = np.asarray(p_ref.nodes).tolist()
+    ref_reason = np.asarray(p_ref.reason).tolist()
+    parity = all(o["placed"] == ref_placed and o["nodes"] == ref_nodes
+                 and o["reason"] == ref_reason for o in outs)
+    avail_mp = np.concatenate([np.asarray(o["avail"]) for o in outs])
+    parity = parity and bool(
+        np.array_equal(avail_mp, np.asarray(s_ref.avail)))
+    if not parity:
+        raise AssertionError(
+            "multi-host solve diverged from the single-process oracle")
+    warm = max(o["warm_s"] for o in outs)
+    fence_s = max(o["fence_s"] for o in outs)
+    return {
+        "jobs": num_jobs, "nodes": num_nodes, "classes": num_classes,
+        "max_nodes": max_nodes,
+        "procs": nprocs, "local_devices_per_proc": local_devices,
+        "mesh": outs[0]["mesh"],
+        "cold_cycle_s": round(max(o["cold_s"] for o in outs), 4),
+        "warm_cycle_s": round(warm, 4),
+        "decisions_per_sec": round(num_jobs / max(warm, 1e-9), 1),
+        "fence_count_per_cycle": outs[0]["fence_count"],
+        "fence_seconds_per_cycle": round(fence_s, 4),
+        "fence_share": round(fence_s / max(warm, 1e-9), 4),
+        "parity_with_single_process": True,
+        "placed": int(sum(ref_placed)),
+        "note": "CPU pod-slice stand-in: real processes + rendezvous "
+                "fences on loopback; on TPU the same path rides ICI "
+                "inside slabs and the host fence between hosts",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -959,6 +1035,15 @@ def main() -> int:
              "p99 under concurrent solve, and the arbiter's placement "
              "share (env BENCH_FEDERATION; shape via BENCH_FED_SPECS/"
              "BENCH_FED_NODES)")
+    ap.add_argument(
+        "--multihost", action="store_true",
+        default=bool(os.environ.get("BENCH_MULTIHOST")),
+        help="also run the multi-host solve scenario: 2 real processes "
+             "(forced CPU host devices) bootstrap a ProcessMesh over a "
+             "rendezvous and run the hierarchical sharded-classes "
+             "solve, bit-exact vs the single-process oracle (env "
+             "BENCH_MULTIHOST; shape via BENCH_MH_JOBS/BENCH_MH_NODES/"
+             "BENCH_MH_PROCS/BENCH_MH_DEVICES)")
     ap.add_argument(
         "--churn", action="store_true",
         default=bool(os.environ.get("BENCH_CHURN")),
@@ -1234,6 +1319,19 @@ def main() -> int:
         except Exception as exc:
             fed_bench = {"error": f"{type(exc).__name__}: {exc}"}
 
+    mh_bench = None
+    if args.multihost:
+        try:
+            mh_bench = _measure_multihost(
+                num_jobs=int(os.environ.get("BENCH_MH_JOBS", 512)),
+                num_nodes=int(os.environ.get("BENCH_MH_NODES", 256)),
+                num_classes=int(os.environ.get("BENCH_MH_CLASSES", 8)),
+                nprocs=int(os.environ.get("BENCH_MH_PROCS", 2)),
+                local_devices=int(os.environ.get("BENCH_MH_DEVICES",
+                                                 4)))
+        except Exception as exc:
+            mh_bench = {"error": f"{type(exc).__name__}: {exc}"}
+
     churn_bench = None
     if args.churn:
         try:
@@ -1264,6 +1362,7 @@ def main() -> int:
             "topology": topo_bench,
             "churn": churn_bench,
             "federation": fed_bench,
+            "multihost": mh_bench,
             "device": str(dev), "repeats": repeats,
             "device_acquisition": acquisition,
         },

@@ -919,7 +919,11 @@ def cmd_cstats(args) -> int:
         print(_fmt_table(rows, ("HA", "VALUE")))
         return 0
     if getattr(args, "cycles", False):
-        rows = [(t.get("now"), t.get("solver"), t.get("queue_depth"),
+        rows = [(t.get("now"), t.get("solver"),
+                 # MESH: solve span as procs x local devices ("1x8" =
+                 # single process over 8 chips); "-" for host solvers
+                 t.get("mesh", "-"),
+                 t.get("queue_depth"),
                  t.get("candidates"), t.get("placed"),
                  t.get("backfilled"), t.get("preempted"),
                  # SKIP: coalesced short-circuit count (+ reason);
@@ -934,10 +938,10 @@ def cmd_cstats(args) -> int:
                  t.get("wal_fsyncs"), t.get("topo_frag", "-"))
                 for t in doc.get("cycle_trace", [])]
         print(_fmt_table(rows, (
-            "NOW", "SOLVER", "QUEUE", "CAND", "PLACED", "BACKFILL",
-            "PREEMPT", "SKIP", "DIRTY", "PRELUDE_MS", "SOLVE_MS",
-            "COMMIT_MS", "DISPATCH_MS", "LOCK_MS", "TOTAL_MS", "FSYNC",
-            "FRAG")))
+            "NOW", "SOLVER", "MESH", "QUEUE", "CAND", "PLACED",
+            "BACKFILL", "PREEMPT", "SKIP", "DIRTY", "PRELUDE_MS",
+            "SOLVE_MS", "COMMIT_MS", "DISPATCH_MS", "LOCK_MS",
+            "TOTAL_MS", "FSYNC", "FRAG")))
         return 0
     if getattr(args, "slo", False):
         rows = []
@@ -1124,6 +1128,15 @@ def cmd_cflight(args) -> int:
         phases = acq.get("phases") or []
         print(f"probe acquired={acq.get('acquired', '?')} "
               f"phases={'->'.join(str(p) for p in phases) or '(none)'}")
+        # the handshake's heartbeat stamps: where the wall-clock went
+        # inside acquisition (the gap after the LAST stamp is the
+        # wedged phase on a timeout)
+        stamps = acq.get("phase_stamps") or []
+        if stamps:
+            t0 = float(stamps[0].get("t") or 0.0)
+            for s in stamps:
+                print(f"  stamp {str(s.get('phase')):<14} "
+                      f"+{float(s.get('t') or 0.0) - t0:.3f}s")
         if acq.get("diagnosis"):
             print(f"diagnosis: {acq['diagnosis']}")
         if acq.get("stacks"):

@@ -97,6 +97,7 @@ class ResidentClusterState:
         # scheduler via pop_cycle_mode)
         self.full_rebuilds = 0
         self.patch_cycles = 0
+        self.ledger_cycles = 0
         self.staged_hits = 0
         self.last_mode: str | None = None
         self.last_h2d_rows = 0
@@ -135,7 +136,12 @@ class ResidentClusterState:
         Ownership transfers to the caller: the solve donates the
         buffers, so this object forgets the state here and must be
         given the solve's returned state via adopt().  Returns
-        ``(state, mode)`` with mode "rebuild" or "patch".
+        ``(state, mode)`` with mode "rebuild", "patch", or "ledger"
+        ("ledger" = empty delta, only the time-dependent cost ledger
+        shipped — exactly 4*N bytes; the BENCH_r10 churn legs ran
+        entirely in this mode but reported it as "patch", which made
+        the steady-state H2D look like patch traffic with zero dirty
+        rows).
         """
         state, self._state = self._state, None
         n = int(np.asarray(avail).shape[0])
@@ -159,13 +165,14 @@ class ResidentClusterState:
             # cost ledger ships — no scatter, trivially overlapped
             state = refresh_cost_ledger(state, cost0)
             self.patch_cycles += 1
+            self.ledger_cycles += 1
             self.staged_hits += 1
-            self.last_mode = self._cycle_mode = "patch"
+            self.last_mode = self._cycle_mode = "ledger"
             self.last_overlap = True
             self.last_h2d_rows = 0
             self.last_h2d_bytes = 4 * n
             self.last_issued_id = id(state)
-            return state, "patch"
+            return state, "ledger"
         if (staged is not None and staged[0] == self.meta.meta_epoch
                 and staged[1] == rows):
             # overlap hit: the delta was uploaded asynchronously at the

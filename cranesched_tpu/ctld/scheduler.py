@@ -2484,8 +2484,8 @@ class JobScheduler:
         ``resident_ok=True`` (only the plain immediate cycle passes it —
         never the backfill-split tail solve, whose ``avail`` is the
         min-over-horizon array, and never under a topology permutation)
-        lets the device/pallas backends use the cross-cycle resident
-        ClusterState instead of rebuilding from host arrays."""
+        lets the device/pallas/sharded backends use the cross-cycle
+        resident ClusterState instead of rebuilding from host arrays."""
         topo = self._active_topology()
         perm = None
         if topo is not None:
@@ -2510,7 +2510,8 @@ class JobScheduler:
                 raise RuntimeError("native solver unavailable")
         if placements is None and self.config.solver == "sharded":
             placements = self._solve_sharded(avail, total, alive, cost0,
-                                             jobs_batch, max_nodes)
+                                             jobs_batch, max_nodes,
+                                             resident_ok=resident_ok)
             solver_name = "sharded"
         if placements is None and self.config.solver == "pallas":
             placements, solver_name = self._solve_pallas(
@@ -2852,12 +2853,21 @@ class JobScheduler:
         return shim
 
     def _solve_sharded(self, avail, total, alive, cost0, jobs_batch,
-                       max_nodes):
+                       max_nodes, resident_ok=False):
         """Node-axis-sharded multi-chip solve (parallel/sharded.py):
         cluster tensors are sharded over every visible device, the
         per-job candidate merge rides ICI all_gathers.  Bit-identical
         placements to solve_greedy (tests/test_sharded_parity.py);
-        the multichip dryrun asserts the same through this exact path."""
+        the multichip dryrun asserts the same through this exact path.
+
+        With ``resident_ok`` the cluster state comes from the
+        cross-cycle resident store: the dirty-row patch scatters into
+        the node-sharded buffers (each row lands on its owning shard)
+        instead of re-uploading the full [N, R] state.  The resident
+        key carries the mesh descriptor (procs x local devices) so any
+        mesh reshape — device count change, future multi-process
+        attach — invalidates the state rather than patching buffers
+        laid out for a different shard map."""
         from cranesched_tpu.parallel.sharded import (
             make_node_mesh,
             shard_cluster_state,
@@ -2869,6 +2879,10 @@ class JobScheduler:
             self._mesh = make_node_mesh()
         mesh = self._mesh
         d = mesh.devices.size
+        # single-process scheduler: 1 process x d local devices (the
+        # multi-process ProcessMesh path reports its own via describe())
+        mesh_desc = f"1x{d}"
+        self._cur_trace["mesh"] = mesh_desc
         n = avail.shape[0]
         pad = (-n) % d
         factored = isinstance(jobs_batch, FactoredJobBatch)
@@ -2890,20 +2904,35 @@ class JobScheduler:
                 jobs_batch = jobs_batch.replace(part_mask=jnp.pad(
                     jobs_batch.part_mask, ((0, 0), (0, pad)),
                     constant_values=False))
-        state = make_cluster_state(avail, total, alive, cost0)
+        use_resident = resident_ok and self._resident.enabled
+        if use_resident:
+            # padded shape + mesh descriptor in the key: a node-count
+            # change (different pad) or mesh reshape drops the state
+            state, _mode = self._resident.acquire(
+                avail, total, alive, cost0,
+                key=("sharded", int(avail.shape[0]),
+                     int(avail.shape[1]),
+                     self._mask_table.generation, mesh_desc))
+        else:
+            state = make_cluster_state(avail, total, alive, cost0)
+        # re-assert the node-axis sharding every cycle: a no-op when
+        # the resident buffers already live on their shards (rebuild /
+        # first cycle is the only real transfer)
         state = shard_cluster_state(state, mesh)
         if factored:
             # class-factored path: the [C, N] table is the only mask
             # that crosses the host→device boundary, and class-disjoint
             # batches decode S jobs per collective round (streamed)
-            placements, _ = solve_greedy_sharded_classes(
+            placements, new_state = solve_greedy_sharded_classes(
                 state, jobs_batch.req, jobs_batch.node_num,
                 jobs_batch.time_limit, jobs_batch.valid,
                 jobs_batch.job_class, class_masks, mesh,
                 max_nodes=max_nodes)
         else:
-            placements, _ = solve_greedy_sharded(
+            placements, new_state = solve_greedy_sharded(
                 state, jobs_batch, mesh, max_nodes=max_nodes)
+        if use_resident:
+            self._resident.adopt(new_state)
         return placements
 
     def _solve_pallas(self, avail, total, alive, cost0, jobs_batch,

@@ -23,11 +23,22 @@ import time
 
 
 def main(argv=None) -> int:
-    # Make JAX_PLATFORMS effective even when a sitecustomize-registered
-    # accelerator plugin overrides it at import time (observed: the env
-    # var alone does not win; only config.update after import does).
-    # Without this, the first scheduling cycle can hang initializing an
-    # unreachable accelerator backend while holding the RPC lock.
+    # Bounded backend acquisition BEFORE anything imports jax (ISSUE
+    # 17): with CPU pre-forced this only re-applies the config-level
+    # forcing (a sitecustomize-registered accelerator plugin overrides
+    # the env var at import time; only config.update after import
+    # wins).  On any other platform it runs the hardened PJRT handshake
+    # from parallel/acquire.py with a hard budget — a wedged plugin
+    # (the r06-r09 failure mode) degrades the daemon to CPU within
+    # CRANE_ACQUIRE_TIMEOUT instead of hanging the first scheduling
+    # cycle while holding the RPC lock.  The structured diagnosis is
+    # replayed into the scheduler's event log once it exists.
+    from cranesched_tpu.parallel.acquire import ensure_backend
+    acquisition = ensure_backend()
+    if not acquisition.get("acquired", False):
+        print(f"WARNING: backend acquisition failed — "
+              f"{acquisition.get('diagnosis', '(no diagnosis)')}",
+              file=sys.stderr, flush=True)
     platforms = os.environ.get("JAX_PLATFORMS")
     if platforms:
         import jax
@@ -76,6 +87,14 @@ def main(argv=None) -> int:
 
     cfg = load_config(args.config)
     meta, scheduler = cfg.build()
+
+    if not acquisition.get("acquired", False):
+        # the boot-time fallback, now as a typed event operators can
+        # query (cevents) and drills can assert on
+        scheduler.events.emit(
+            "backend_degraded", severity="error",
+            detail=acquisition.get("diagnosis",
+                                   "backend acquisition failed")[:800])
 
     if cfg.acct_store_path and scheduler.accounts is not None:
         print(f"accounting store: {cfg.acct_store_path} "

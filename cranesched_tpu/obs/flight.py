@@ -19,13 +19,16 @@ all-thread stack dump:
   can prove the recorder costs <= 1% of a cycle.
 
 * The heartbeat protocol — :class:`Heartbeat` writes one fsync'd JSON
-  line per named phase (``PROBE_PHASES``: jax import -> backend init ->
-  first trace -> first compile -> first execute -> steady state);
+  line per named phase (``PROBE_PHASES``: env preflight -> jax import
+  -> backend init -> device enum -> first trace -> first compile ->
+  first execute -> steady state);
   :func:`read_heartbeat` parses the file tolerantly (a probe killed
   mid-write leaves a torn last line, which is dropped, never raised
   on).  bench.py's TPU probe subprocess stamps these so the parent's
   timeout handler can say WHICH phase hung and harvest the child's
-  ``faulthandler`` stack dump into the BENCH_*.json diagnosis.
+  ``faulthandler`` stack dump into the BENCH_*.json diagnosis.  The
+  acquisition half of the protocol (and the probe subprocess itself)
+  lives in parallel/acquire.py.
 
 * :func:`enable_xla_cache` — points ``jax_compilation_cache_dir`` at a
   persistent directory (default ``profiles/xla_cache/``) with the size
@@ -57,8 +60,13 @@ from cranesched_tpu.obs.metrics import REGISTRY as _OBS
 
 #: the probe subprocess's named phases, in order.  A stamp marks the
 #: phase's START — on a timeout, the last stamp names where it hung.
-PROBE_PHASES = ("jax_import", "backend_init", "first_trace",
-                "first_compile", "first_execute", "steady_state")
+#: The first four are the acquisition handshake (owned by
+#: parallel/acquire.py: env pre-flight, jax import, the PJRT
+#: plugin/runtime init that BENCH_r10 caught wedged, device
+#: enumeration); the tail is the bench probe's compile warm-up.
+PROBE_PHASES = ("env_preflight", "jax_import", "backend_init",
+                "device_enum", "first_trace", "first_compile",
+                "first_execute", "steady_state")
 
 _MET_STAMPS = _OBS.counter(
     "crane_flight_stamps_total",

@@ -6,14 +6,28 @@ travel over ICI as ``all_gather``/``psum`` collectives.  See
 ``parallel.sharded`` for the design notes.
 """
 
-from cranesched_tpu.parallel.sharded import (
-    make_node_mesh,
-    shard_cluster_state,
-    solve_greedy_sharded,
-)
+# Lazy exports: parallel.acquire must be importable WITHOUT pulling
+# jax into the process (the acquisition probe's whole point is deciding
+# whether jax backend bring-up is safe), and sharded.py imports jax at
+# module scope.
+_SHARDED = ("make_node_mesh", "shard_cluster_state",
+            "solve_greedy_sharded", "solve_greedy_sharded_classes")
+_DISTRIBUTED = ("bootstrap_process_mesh", "ProcessMesh",
+                "solve_greedy_sharded_classes_mp")
+_ACQUIRE = ("acquire_backend", "ensure_backend", "preflight_report")
 
-__all__ = [
-    "make_node_mesh",
-    "shard_cluster_state",
-    "solve_greedy_sharded",
-]
+__all__ = [*_SHARDED, *_DISTRIBUTED, *_ACQUIRE]
+
+
+def __getattr__(name):
+    import importlib
+    if name in _SHARDED:
+        mod = importlib.import_module("cranesched_tpu.parallel.sharded")
+    elif name in _DISTRIBUTED:
+        mod = importlib.import_module(
+            "cranesched_tpu.parallel.distributed")
+    elif name in _ACQUIRE:
+        mod = importlib.import_module("cranesched_tpu.parallel.acquire")
+    else:
+        raise AttributeError(name)
+    return getattr(mod, name)

@@ -1,0 +1,379 @@
+"""Hardened device acquisition: the bounded PJRT handshake.
+
+Four benches in a row (r06-r09) hung acquiring the TPU backend, and
+BENCH_r10's flight-recorder diagnosis finally named the culprit:
+``backend_init``, wedged inside ``xla_client.initialize_pjrt_plugin``
+(the TPU PJRT plugin) — before any compile, before any trace.  This
+module makes backend bring-up a *bounded, observable handshake* instead
+of an unbounded import side effect:
+
+* :func:`preflight_report` — a stdlib-only snapshot of the environment
+  the PJRT plugin is about to trust: ``TPU_*`` env vars, the libtpu
+  shared object the plugin will dlopen, accelerator chip visibility
+  (``/dev/accel*`` / ``/dev/vfio``), and the ``JAX_PLATFORMS`` routing.
+  Collected BEFORE jax is imported, so a wedged plugin can never blind
+  it — on a hang, the diagnosis says *why* the handshake had a chance
+  to wedge (no chips visible, no libtpu, a stale ``TPU_*`` grpc
+  address), not just *that* it did.
+
+* :func:`acquire_backend` — the probe: a stdlib-self-contained
+  subprocess stamps the acquisition phases (``env_preflight ->
+  jax_import -> backend_init -> device_enum``, then the compile-warm
+  phases when ``warm=True``) into an fsync'd heartbeat file
+  (obs/flight.py protocol).  The parent enforces a hard budget; on
+  expiry it harvests the child's ``faulthandler`` stacks via SIGUSR1,
+  kills it, forces ``JAX_PLATFORMS=cpu`` in the CURRENT process, emits
+  a typed ``backend_degraded`` event through the caller's sink, and
+  returns a structured diagnosis — never a bare timeout.
+
+* :func:`ensure_backend` — the scheduler's boot-path wrapper
+  (ctld_main): skip when CPU is already forced, otherwise run the
+  handshake (without compile warming) so a wedged plugin degrades the
+  daemon to CPU within the budget instead of hanging the first cycle
+  under the RPC lock.
+
+``BENCH_ACQUIRE_INJECT_HANG=<phase>`` wedges the named phase on purpose
+(the forensics self-test, mirroring ``BENCH_PROBE_INJECT_HANG`` which
+is honored as an alias so existing drills keep working).
+
+Metrics: ``crane_backend_acquire_seconds`` (histogram, by outcome) and
+``crane_backend_acquire_failures_total`` (counter, by phase).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+from cranesched_tpu.obs.flight import PROBE_PHASES, read_heartbeat
+from cranesched_tpu.obs.metrics import REGISTRY as _OBS
+
+#: backend bring-up phases owned by this layer (the first four entries
+#: of the full heartbeat protocol); the compile-warm tail belongs to
+#: the bench probe and only runs with ``warm=True``.
+ACQUIRE_PHASES = PROBE_PHASES[:4]
+WARM_PHASES = PROBE_PHASES[4:]
+
+#: boot-path budget (seconds) before the CPU fallback; override with
+#: CRANE_ACQUIRE_TIMEOUT.  Deliberately smaller than the bench probe's
+#: 420 s — a daemon must come up degraded fast, a bench can afford to
+#: wait out a slow tunnel.
+DEFAULT_BOOT_TIMEOUT_S = 120.0
+
+_MET_ACQ_SECONDS = _OBS.histogram(
+    "crane_backend_acquire_seconds",
+    "wall time of the bounded PJRT backend-acquisition handshake, "
+    "labeled by outcome (ok | timeout | error)")
+_MET_ACQ_FAILURES = _OBS.counter(
+    "crane_backend_acquire_failures_total",
+    "backend acquisitions that timed out or errored, labeled by the "
+    "last heartbeat phase reached (where the handshake wedged)")
+
+
+def _tpu_env() -> dict:
+    """Every env var the TPU PJRT plugin reads, values truncated."""
+    keys = {k: v for k, v in os.environ.items()
+            if k.startswith(("TPU_", "LIBTPU", "PJRT_"))}
+    for extra in ("JAX_PLATFORMS", "XLA_FLAGS", "LD_LIBRARY_PATH"):
+        if extra in os.environ:
+            keys[extra] = os.environ[extra]
+    return {k: (v[:120] + "..." if len(v) > 120 else v)
+            for k, v in sorted(keys.items())}
+
+
+def _find_libtpu() -> str:
+    """The shared object ``initialize_pjrt_plugin`` will dlopen, if
+    discoverable without importing jax."""
+    explicit = os.environ.get("TPU_LIBRARY_PATH", "")
+    if explicit and os.path.exists(explicit):
+        return explicit
+    try:
+        import importlib.util
+        spec = importlib.util.find_spec("libtpu")
+        if spec is not None and spec.submodule_search_locations:
+            for loc in spec.submodule_search_locations:
+                for name in ("libtpu.so", "libtpu.so.1"):
+                    cand = os.path.join(loc, name)
+                    if os.path.exists(cand):
+                        return cand
+                return loc  # package present, .so layout unknown
+    except Exception:
+        pass
+    for root in sys.path:
+        if not root:
+            continue
+        cand = os.path.join(root, "libtpu", "libtpu.so")
+        if os.path.exists(cand):
+            return cand
+    return ""
+
+
+def preflight_report() -> dict:
+    """Stdlib-only environment snapshot taken before any jax import —
+    the "why could the plugin wedge" half of a hang diagnosis."""
+    accel = sorted(glob.glob("/dev/accel*"))
+    vfio = sorted(glob.glob("/dev/vfio/*"))
+    libtpu = _find_libtpu()
+    return {
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", "(unset)"),
+        "libtpu_path": libtpu or "(not found)",
+        "tpu_env": _tpu_env(),
+        "chips": {"dev_accel": accel, "dev_vfio": vfio,
+                  "visible": len(accel) + len(vfio)},
+    }
+
+
+def _preflight_summary(pf: dict) -> str:
+    tpu_keys = [k for k in pf.get("tpu_env", {})
+                if k.startswith(("TPU_", "LIBTPU"))]
+    chips = pf.get("chips", {})
+    return (f"env pre-flight: libtpu={pf.get('libtpu_path')!r}, "
+            f"TPU_* vars={tpu_keys or '(none)'}, chip visibility "
+            f"dev_accel={len(chips.get('dev_accel', []))} "
+            f"dev_vfio={len(chips.get('dev_vfio', []))}, "
+            f"JAX_PLATFORMS={pf.get('jax_platforms')!r}")
+
+
+# The probe child (obs/flight.py heartbeat protocol).  Deliberately
+# stdlib-self-contained: importing cranesched_tpu here could pull jax
+# via package __init__s BEFORE the jax_import stamp, which would blind
+# the one phase the probe most suspects.  A stamp marks the phase's
+# START, fsync'd before proceeding, so on a hang the last line on disk
+# names the phase it died in.
+_ACQUIRE_PROBE_SRC = r"""
+import faulthandler, json, os, signal, sys, time
+
+hb_path, stack_path, cache_dir, warm = (
+    sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4] == "1")
+hb = open(hb_path, "a", encoding="utf-8")
+
+
+def stamp(phase, **extra):
+    rec = {"t": time.time(), "phase": phase}
+    rec.update(extra)
+    hb.write(json.dumps(rec) + "\n")
+    hb.flush()
+    os.fsync(hb.fileno())
+    hang = (os.environ.get("BENCH_ACQUIRE_INJECT_HANG", "")
+            or os.environ.get("BENCH_PROBE_INJECT_HANG", ""))
+    if hang == phase:
+        time.sleep(3600.0)
+
+
+# the parent harvests this on timeout: SIGUSR1 -> all-thread tracebacks
+stack_fh = open(stack_path, "w", encoding="utf-8")
+faulthandler.register(signal.SIGUSR1, file=stack_fh, all_threads=True)
+
+stamp("env_preflight")
+stamp("jax_import")
+import jax
+
+cache = {"enabled": False, "hits": 0, "misses": 0, "error": ""}
+try:
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    import jax.monitoring as _mon
+
+    def _ev(event, **kw):
+        if event.endswith("cache_hits"):
+            cache["hits"] += 1
+        elif event.endswith("cache_misses"):
+            cache["misses"] += 1
+
+    _mon.register_event_listener(_ev)
+    cache["enabled"] = True
+except Exception as e:
+    cache["error"] = "%s: %s" % (type(e).__name__, e)
+
+# backend_init is the PJRT plugin/runtime handshake itself — the phase
+# BENCH_r10 caught wedged inside xla_client.initialize_pjrt_plugin
+stamp("backend_init")
+try:
+    from jax.extend import backend as _jxb
+    _backend = _jxb.get_backend()
+except Exception:
+    _backend = None
+stamp("device_enum")
+ds = jax.devices()
+if warm:
+    stamp("first_trace")
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    fn = jax.jit(lambda v: (v * 2.0 + 1.0).sum())
+    lowered = fn.lower(x)
+    stamp("first_compile")
+    compiled = lowered.compile()
+    stamp("first_execute")
+    float(compiled(x))
+    stamp("steady_state")
+    float(fn(x))
+try:
+    cache["entries"] = sum(1 for f in os.listdir(cache_dir)
+                           if f.endswith("-cache"))
+except OSError:
+    cache["entries"] = 0
+print(json.dumps({"ok": True, "platform": ds[0].platform,
+                  "device_count": len(ds), "xla_cache": cache}))
+"""
+
+
+def _force_cpu_here() -> None:
+    """Make THIS process unreachable for the wedged plugin: force CPU
+    before jax initializes (env var alone does not win over a
+    sitecustomize-registered plugin; config.update after import does)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized — nothing to force
+
+
+def acquire_backend(timeout_s: float, *, warm: bool = True,
+                    cache_dir: str | None = None,
+                    event_sink=None) -> dict:
+    """Probe backend bring-up ONCE in a subprocess with a hard budget;
+    fall back to CPU so the caller always makes progress.
+
+    The probe stamps named phases (obs/flight.py PROBE_PHASES) into an
+    fsync'd heartbeat file, so a timeout is never bare: the diagnosis
+    names the phase it hung in, carries the child's faulthandler stack
+    dump (harvested via SIGUSR1 before the kill), and the env
+    pre-flight report saying why the plugin had a chance to wedge.
+    ``event_sink(type, severity, detail)`` — e.g. a bound
+    ``EventLog.emit`` — receives a typed ``backend_degraded`` event on
+    any failure.  The returned dict lands verbatim in bench output /
+    boot logs: a CPU number must never masquerade as a TPU result
+    without saying why."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    preflight = preflight_report()
+    workdir = tempfile.mkdtemp(prefix="crane-acquire-")
+    hb_path = os.path.join(workdir, "heartbeat.jsonl")
+    stack_path = os.path.join(workdir, "stacks.txt")
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "BENCH_XLA_CACHE_DIR", os.path.join("profiles", "xla_cache"))
+    t0 = _time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _ACQUIRE_PROBE_SRC,
+         hb_path, stack_path, cache_dir, "1" if warm else "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    timed_out = False
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        # harvest the child's stacks while it is still wedged: SIGUSR1
+        # fires its faulthandler dump, then the kill
+        try:
+            proc.send_signal(signal.SIGUSR1)
+            _time.sleep(2.0)
+        except Exception:
+            pass
+        proc.kill()
+        out, err = proc.communicate()
+    elapsed = round(_time.monotonic() - t0, 1)
+    beats = read_heartbeat(hb_path)
+    phases = [b["phase"] for b in beats]
+    stamps = [{"phase": b["phase"], "t": b["t"]} for b in beats]
+    protocol = (PROBE_PHASES if warm else ACQUIRE_PHASES)
+    if not timed_out and proc.returncode == 0:
+        doc = {}
+        try:
+            doc = json.loads(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            pass
+        if doc.get("ok"):
+            _MET_ACQ_SECONDS.observe(elapsed, outcome="ok")
+            return {"acquired": True,
+                    "attempts": [{"outcome": "ok",
+                                  "seconds": elapsed}],
+                    "platform": doc.get("platform", ""),
+                    "device_count": doc.get("device_count", 0),
+                    "phases": phases,
+                    "phase_stamps": stamps,
+                    "preflight": preflight,
+                    "xla_cache": doc.get("xla_cache", {})}
+    try:
+        with open(stack_path, encoding="utf-8") as fh:
+            stacks = fh.read().strip()
+    except OSError:
+        stacks = ""
+    configured = os.environ.get("JAX_PLATFORMS", "auto")
+    _force_cpu_here()
+    last = phases[-1] if phases else "(no heartbeat — died pre-stamp)"
+    if timed_out:
+        pos = (f"{protocol.index(last) + 1}/{len(protocol)}"
+               if last in protocol else "?")
+        attempt = {"outcome": "timeout", "seconds": elapsed,
+                   "last_phase": last, "phases": phases}
+        diagnosis = (
+            f"the device-acquisition handshake on platform "
+            f"{configured!r} hung in phase {last!r} ({pos} of the "
+            f"heartbeat protocol) and did not finish within the "
+            f"{timeout_s:.0f} s budget; "
+            f"{'an all-thread stack dump was captured' if stacks else 'no stack dump could be harvested'}. "
+            f"{_preflight_summary(preflight)}. "
+            "Falling back to CPU so the caller still makes progress; "
+            "the backend below is therefore NOT a TPU.")
+        _MET_ACQ_SECONDS.observe(elapsed, outcome="timeout")
+    else:
+        attempt = {
+            "outcome": f"rc={proc.returncode}", "seconds": elapsed,
+            "phases": phases,
+            "tail": ((err or out) or "").strip()[-300:]}
+        diagnosis = (
+            f"the device-acquisition handshake on platform "
+            f"{configured!r} exited with {attempt['outcome']} after "
+            f"{elapsed} s having reached phase "
+            f"{phases[-1] if phases else '(none)'!r} "
+            f"({attempt['tail']!r}). {_preflight_summary(preflight)}. "
+            "Falling back to CPU so the caller still makes progress; "
+            "the backend below is therefore NOT a TPU.")
+        _MET_ACQ_SECONDS.observe(elapsed, outcome="error")
+    _MET_ACQ_FAILURES.inc(phase=last if last in protocol else "(none)")
+    if event_sink is not None:
+        try:
+            event_sink("backend_degraded", "error",
+                       f"acquisition {attempt['outcome']} in phase "
+                       f"{last!r} after {elapsed}s; running on CPU "
+                       f"fallback ({_preflight_summary(preflight)})")
+        except Exception:
+            pass  # a broken sink must never mask the fallback itself
+    return {"acquired": False, "attempts": [attempt],
+            "diagnosis": diagnosis, "phases": phases,
+            "phase_stamps": stamps, "preflight": preflight,
+            "last_phase": phases[-1] if phases else "",
+            "stacks": stacks[-4000:]}
+
+
+def ensure_backend(timeout_s: float | None = None,
+                   event_sink=None) -> dict:
+    """The scheduler boot path: make backend bring-up bounded before
+    the first cycle can touch jax under the RPC lock.
+
+    CPU already forced -> nothing to probe (the env-forcing half is
+    still applied, matching the historic ctld_main behavior).
+    Otherwise run
+    the acquisition handshake WITHOUT compile warming; on failure the
+    process is already degraded to CPU by the time this returns."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("CRANE_ACQUIRE_TIMEOUT",
+                                         DEFAULT_BOOT_TIMEOUT_S))
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms == "cpu":
+        _force_cpu_here()
+        return {"acquired": True, "platform": "cpu", "attempts": [],
+                "note": "JAX_PLATFORMS=cpu was pre-set",
+                "preflight": preflight_report()}
+    return acquire_backend(timeout_s, warm=False,
+                           event_sink=event_sink)

@@ -22,6 +22,15 @@ Capabilities:
 A per-gang bearer token (``CRANE_RENDEZVOUS_TOKEN``) gates every call:
 anyone who can reach the port could otherwise skew a barrier or
 poison the modex.
+
+Epochs (ISSUE 17): the coordinator carries an incarnation number.  A
+member still retrying against a restarted coordinator — or lagging a
+step behind the rest of the gang after a partial failure — gets a
+typed ``stale epoch`` rejection instead of silently contributing to
+the wrong barrier round (the rank-skew corruption mode: rank A's step
+N+1 contribution satisfying rank B's step N fence).  Fence state is
+keyed per ``(fence_id, epoch)``; epoch 0 means "no check" for
+pre-epoch clients.
 """
 
 from __future__ import annotations
@@ -52,9 +61,14 @@ class RendezvousServer:
     the barrier (the final ranks' RPCs queue behind the parked ones
     and the fence times out at N_pool/N arrived)."""
 
-    def __init__(self, token: str = "", nranks: int = 0, tls=None):
+    def __init__(self, token: str = "", nranks: int = 0, tls=None,
+                 epoch: int = 0):
         self.token = token
         self.nranks = nranks
+        # coordinator incarnation: a restarted coordinator comes back
+        # with a higher epoch so members of the previous incarnation
+        # fail fast (stale epoch) instead of skewing fresh barriers
+        self.epoch = epoch
         # utils.pki.TlsConfig (the hosting node's cluster cert): when
         # set, the service serves TLS so the per-gang bearer token and
         # modex/fence payloads never ride plaintext node-to-node in
@@ -63,7 +77,7 @@ class RendezvousServer:
         self.tls = tls
         self._kv: dict[str, bytes] = {}
         self._kv_cond = threading.Condition()
-        self._fences: dict[str, _FenceState] = {}
+        self._fences: dict[tuple[str, int], _FenceState] = {}
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self.port = 0
@@ -78,8 +92,20 @@ class RendezvousServer:
             context.abort(grpc.StatusCode.PERMISSION_DENIED,
                           "bad rendezvous token")
 
+    def _stale(self, req_epoch: int) -> str:
+        """Non-empty error when ``req_epoch`` belongs to a previous
+        coordinator incarnation (0 on either side disables the check
+        for pre-epoch clients/servers)."""
+        if self.epoch and req_epoch and req_epoch != self.epoch:
+            return (f"stale epoch {req_epoch} (coordinator at "
+                    f"incarnation {self.epoch})")
+        return ""
+
     def Put(self, request, context):
         self._check(context)
+        stale = self._stale(request.epoch)
+        if stale:
+            return pb.OkReply(ok=False, error=stale)
         with self._kv_cond:
             self._kv[request.key] = request.value
             self._kv_cond.notify_all()
@@ -99,15 +125,21 @@ class RendezvousServer:
 
     def Fence(self, request, context):
         self._check(context)
+        stale = self._stale(request.epoch)
+        if stale:
+            return pb.RdzvFenceReply(ok=False, error=stale,
+                                     epoch=self.epoch)
         if request.nranks < 1 or request.rank >= request.nranks:
             return pb.RdzvFenceReply(
                 ok=False, error=f"bad rank {request.rank}/"
-                                f"{request.nranks}")
+                                f"{request.nranks}",
+                epoch=self.epoch)
+        fkey = (request.fence_id, request.epoch)
         with self._lock:
-            st = self._fences.get(request.fence_id)
+            st = self._fences.get(fkey)
             if st is None or st.done.is_set():
-                # fresh epoch of this fence name
-                st = self._fences[request.fence_id] = _FenceState(
+                # fresh round of this fence name (within this epoch)
+                st = self._fences[fkey] = _FenceState(
                     request.nranks)
             if st.nranks != request.nranks:
                 st.error = (f"nranks mismatch: {st.nranks} vs "
@@ -116,7 +148,8 @@ class RendezvousServer:
             elif request.rank in st.data:
                 return pb.RdzvFenceReply(
                     ok=False, error=f"duplicate rank {request.rank} "
-                                    "in fence")
+                                    "in fence",
+                    epoch=self.epoch)
             else:
                 st.data[request.rank] = request.data
                 if len(st.data) == st.nranks:
@@ -127,16 +160,20 @@ class RendezvousServer:
                     # withdraw the contribution so THIS rank can retry
                     # the same fence (leaving it would wedge the epoch
                     # on 'duplicate rank' forever)
+                    arrived = len(st.data)
                     st.data.pop(request.rank, None)
                     return pb.RdzvFenceReply(
                         ok=False,
-                        error=f"fence timeout ({len(st.data)}/"
-                              f"{st.nranks} arrived)")
+                        error=f"fence timeout ({arrived}/"
+                              f"{st.nranks} arrived)",
+                        epoch=self.epoch)
             # completed at the buzzer: fall through to the result
         if st.error:
-            return pb.RdzvFenceReply(ok=False, error=st.error)
+            return pb.RdzvFenceReply(ok=False, error=st.error,
+                                     epoch=self.epoch)
         return pb.RdzvFenceReply(
-            ok=True, data=[st.data[r] for r in range(st.nranks)])
+            ok=True, data=[st.data[r] for r in range(st.nranks)],
+            epoch=self.epoch)
 
     # ---- lifecycle ----
 
@@ -195,14 +232,24 @@ class RendezvousClient:
     """Member-side stub (used by cranesched_tpu.coord) — the shared
     GrpcStub plumbing with the gang-token header."""
 
-    def __init__(self, address: str, token: str = "", tls=None):
+    def __init__(self, address: str, token: str = "", tls=None,
+                 epoch: int = 0):
         from cranesched_tpu.rpc.stub import GrpcStub
         self._stub = GrpcStub(address, RDZV_SERVICE, token=token,
                               token_key="crane-rdzv-token", tls=tls)
+        # default incarnation stamped on every call (0 = no-check);
+        # per-call override via the epoch= kwarg
+        self.epoch = epoch
 
-    def put(self, key: str, value: bytes) -> None:
-        self._stub.call("Put", pb.RdzvPutRequest(key=key, value=value),
-                        pb.OkReply)
+    def put(self, key: str, value: bytes,
+            epoch: int | None = None) -> None:
+        reply = self._stub.call(
+            "Put", pb.RdzvPutRequest(
+                key=key, value=value,
+                epoch=self.epoch if epoch is None else epoch),
+            pb.OkReply)
+        if not reply.ok:
+            raise RuntimeError(f"put {key!r} rejected: {reply.error}")
 
     def get(self, key: str, timeout: float = 0.0) -> bytes | None:
         reply = self._stub.call(
@@ -211,12 +258,14 @@ class RendezvousClient:
         return reply.value if reply.ok else None
 
     def fence(self, fence_id: str, rank: int, nranks: int,
-              data: bytes = b"", timeout: float = 300.0) -> list[bytes]:
+              data: bytes = b"", timeout: float = 300.0,
+              epoch: int | None = None) -> list[bytes]:
         reply = self._stub.call(
             "Fence",
-            pb.RdzvFenceRequest(fence_id=fence_id, rank=rank,
-                                nranks=nranks, data=data,
-                                timeout=timeout),
+            pb.RdzvFenceRequest(
+                fence_id=fence_id, rank=rank, nranks=nranks, data=data,
+                timeout=timeout,
+                epoch=self.epoch if epoch is None else epoch),
             pb.RdzvFenceReply, timeout=timeout + 30.0)
         if not reply.ok:
             raise RuntimeError(f"fence {fence_id!r} failed: "

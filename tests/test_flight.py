@@ -166,13 +166,70 @@ def test_probe_forced_hang_names_phase_and_captures_stack(
     res = bench._devices_with_timeout(8.0)
     assert res["acquired"] is False
     assert res["last_phase"] == "jax_import"
-    assert res["phases"] == ["jax_import"]
+    assert res["phases"] == ["env_preflight", "jax_import"]
     assert "hung in phase 'jax_import'" in res["diagnosis"]
-    assert "1/6 of the heartbeat protocol" in res["diagnosis"]
+    assert "2/8 of the heartbeat protocol" in res["diagnosis"]
+    # the env pre-flight report rides the diagnosis: on a real TPU
+    # wedge it says WHY the plugin had a chance to hang
+    assert "env pre-flight" in res["diagnosis"]
+    assert "libtpu" in res["diagnosis"]
+    assert res["preflight"]["chips"]["visible"] >= 0
     # SIGUSR1 harvested the wedged child's stacks before the kill: the
     # injected hang sleeps inside stamp(), which must be visible
     assert res["stacks"]
     assert "stamp" in res["stacks"]
+
+
+def test_acquire_hang_hook_emits_backend_degraded_and_falls_back(
+        tmp_path, monkeypatch):
+    """The scheduler-boot half of the acquisition hardening: the
+    BENCH_ACQUIRE_INJECT_HANG hook wedges the PJRT handshake, the
+    bounded acquire must (a) attribute the phase, (b) emit a typed
+    backend_degraded event through the sink, (c) leave the process
+    forced to CPU — all within the budget."""
+    from cranesched_tpu.parallel.acquire import (
+        ACQUIRE_PHASES,
+        acquire_backend,
+    )
+    monkeypatch.setenv("BENCH_ACQUIRE_INJECT_HANG", "backend_init")
+    monkeypatch.delenv("BENCH_PROBE_INJECT_HANG", raising=False)
+    monkeypatch.setenv("BENCH_XLA_CACHE_DIR", str(tmp_path / "xla"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert ACQUIRE_PHASES == PROBE_PHASES[:4]
+    events = []
+    t0 = time.monotonic()
+    res = acquire_backend(8.0, warm=False,
+                          event_sink=lambda type, sev, detail:
+                          events.append((type, sev, detail)))
+    assert time.monotonic() - t0 < 30.0  # budget + harvest grace
+    assert res["acquired"] is False
+    assert res["last_phase"] == "backend_init"
+    assert "3/4 of the heartbeat protocol" in res["diagnosis"]
+    assert [e[0] for e in events] == ["backend_degraded"]
+    assert events[0][1] == "error"
+    assert "backend_init" in events[0][2]
+    # CPU fallback applied to THIS process
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    # per-phase stamps for cflight: monotone times, named phases
+    stamps = res["phase_stamps"]
+    assert [s["phase"] for s in stamps] == res["phases"]
+    assert all(a["t"] <= b["t"] for a, b in zip(stamps, stamps[1:]))
+
+
+def test_ensure_backend_short_circuits_on_forced_cpu(monkeypatch):
+    """With JAX_PLATFORMS=cpu pre-set the boot path must not pay a
+    probe subprocess at all — just re-apply the config forcing."""
+    from cranesched_tpu.parallel.acquire import ensure_backend
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # a hang injection that would wedge any probe proves none ran
+    monkeypatch.setenv("BENCH_ACQUIRE_INJECT_HANG", "env_preflight")
+    t0 = time.monotonic()
+    res = ensure_backend(timeout_s=60.0)
+    assert time.monotonic() - t0 < 5.0
+    assert res["acquired"] is True
+    assert res["platform"] == "cpu"
+    assert res["attempts"] == []
+    assert "preflight" in res
 
 
 def test_probe_happy_path_completes_protocol_and_warms_xla_cache(
@@ -634,6 +691,31 @@ def test_cflight_renders_bench_probe_diagnosis(tmp_path, capsys):
     capsys.readouterr()
     assert cmd_cflight(args) == 1
     assert "hung in phase 'first_trace'" in capsys.readouterr().out
+
+
+def test_cflight_renders_acquisition_phase_stamps(tmp_path, capsys):
+    """ISSUE 17: the acquisition handshake's heartbeat stamps render
+    as a relative timeline, so the gap after the last stamp names the
+    wedged phase at a glance."""
+    from cranesched_tpu.cli import cmd_cflight
+    doc = {"device_acquisition": {
+        "acquired": False,
+        "phases": ["env_preflight", "jax_import", "backend_init"],
+        "phase_stamps": [
+            {"phase": "env_preflight", "t": 100.0},
+            {"phase": "jax_import", "t": 100.25},
+            {"phase": "backend_init", "t": 101.5},
+        ],
+        "diagnosis": "wedged in backend_init",
+    }}
+    path = tmp_path / "BENCH_r11.json"
+    path.write_text(json.dumps(doc))
+    args = types.SimpleNamespace(file=str(path), tail=32)
+    assert cmd_cflight(args) == 1
+    out = capsys.readouterr().out
+    assert "stamp env_preflight" in out and "+0.000s" in out
+    assert "stamp jax_import" in out and "+0.250s" in out
+    assert "stamp backend_init" in out and "+1.500s" in out
 
 
 def test_cflight_renders_live_stall(capsys):

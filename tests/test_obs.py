@@ -210,6 +210,7 @@ def test_cstats_cli_cycles_and_metrics(capsys):
                          "cstats", "--cycles"]) == 0
         out = capsys.readouterr().out
         assert "SOLVER" in out and "LOCK_MS" in out
+        assert "MESH" in out  # procs x local devices (ISSUE 17)
         assert cli_main(["--server", f"127.0.0.1:{port}",
                          "cstats", "--metrics"]) == 0
         out = capsys.readouterr().out

@@ -233,6 +233,20 @@ def test_oracle_parity_randomized_pallas():
     assert sched._resident.patch_cycles > 0
 
 
+def test_oracle_parity_randomized_sharded():
+    """ISSUE 17: the node-sharded backend rides the same resident
+    store — the dirty-row patch scatters into the node-sharded buffers
+    (each row lands on its owning shard) and must stay bit-exact
+    against the per-cycle rebuild."""
+    sched = _parity_script("sharded", ticks=10, seed=11)
+    assert sched._resident.patch_cycles > 0
+    # mesh-aware resident key + the trace's mesh descriptor (the
+    # conftest pins an 8-device CPU platform -> 1 process x 8 devices)
+    assert _trace(sched)["mesh"] == "1x8"
+    assert sched._resident._key[0] == "sharded"
+    assert sched._resident._key[-1] == "1x8"
+
+
 def test_commit_rejection_divergence_parity():
     """License-capped jobs: the device solver places them, the host
     commit rejects — the rows it touched must be force-patched back so

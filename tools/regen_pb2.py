@@ -293,6 +293,18 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
         ("fencing_epoch", 2, F.TYPE_UINT64),
     ])
 
+    # gang rendezvous epochs (ISSUE 17): the coordinator tags its
+    # incarnation; a member still retrying against a restarted
+    # coordinator gets a typed stale-epoch rejection instead of
+    # skewing a fresh barrier or poisoning the modex (0 = no-check,
+    # pre-epoch clients)
+    n += _add_field(_msg(fd, "RdzvPutRequest"), "epoch", 3,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "RdzvFenceRequest"), "epoch", 6,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "RdzvFenceReply"), "epoch", 4,
+                    F.TYPE_UINT64)
+
     # new CraneCtld methods (hand-glued handlers key off _RPCS, but the
     # descriptor stays the wire contract of record)
     n += _add_rpc(fd, "CraneCtld", "RequeueJob", "JobIdRequest",

@@ -51,16 +51,26 @@ assert ch["idle_tick_share"] < 0.05, (
 assert ch["placements_match"], (
     f"incremental vs rebuild placed different first waves: {ch}")
 # device-resident state guards: steady-state churn cycles must run the
-# dirty-row scatter patch (never a silent full [N,R] rebuild), the
-# host->device bytes must stay under the dirty-rows bound, the delta
-# upload must be double-buffered (staged by the previous cycle), and
-# sched_cycle must report the new pipeline-shape fields for BENCH_r06
+# dirty-row scatter patch or the ledger-only refresh (never a silent
+# full [N,R] rebuild), the host->device bytes must stay under the
+# mode-appropriate bound, the delta upload must be double-buffered
+# (staged by the previous cycle), and sched_cycle must report the new
+# pipeline-shape fields for BENCH_r06.  ISSUE 17: empty-delta cycles
+# now label themselves "ledger" (only the [N] cost seed ships — the
+# BENCH_r10 "patch with dirty_nodes=0" anomaly), and an all-ledger
+# steady state is held to EXACTLY 4*N bytes, not the padded dirty-row
+# formula.
 rs = ch["resident"]
 assert rs["steady_state_patch"], (
     f"a steady churn cycle fell back to a full [N,R] rebuild: {rs}")
 assert rs["h2d_bytes_per_cycle"] <= rs["dirty_bound_bytes"], (
     f"resident patch shipped {rs['h2d_bytes_per_cycle']}B/cycle, over "
     f"the dirty-rows bound {rs['dirty_bound_bytes']}B: {rs}")
+if rs["steady_state_ledger_only"]:
+    assert rs["h2d_bytes_per_cycle"] == rs["dirty_bound_bytes"], (
+        f"all-ledger steady state must ship exactly the 4*N cost seed "
+        f"({rs['dirty_bound_bytes']}B), saw "
+        f"{rs['h2d_bytes_per_cycle']}B: {rs}")
 assert rs["h2d_bytes_per_cycle"] < rs["full_state_bytes"], (
     f"resident patch bytes not below a full rebuild: {rs}")
 assert rs["patch_overlap_share"] >= 0.99, (
@@ -110,6 +120,7 @@ print(f"TIER1_PERF_OK prelude_share={share:.3f} "
       f"churn_prelude_speedup={ch['prelude_speedup']} "
       f"idle_tick_share={ch['idle_tick_share']} "
       f"resident_h2d_bytes={rs['h2d_bytes_per_cycle']} "
+      f"resident_modes={rs['steady_state_modes']} "
       f"patch_overlap_share={rs['patch_overlap_share']} "
       f"trace_overhead_share={tg['trace_overhead_share']} "
       f"flight_share={fg['flight_overhead_share']} "
@@ -152,4 +163,40 @@ print(f"TIER1_FED_OK submit_speedup={doc['submit_speedup']} "
       f"single_submits_per_s={doc['single']['submits_per_s']} "
       f"fed_submits_per_s={doc['federated']['submits_per_s']} "
       f"arbiter_commits={doc['arbiter']['commits']}")
+PY
+
+# multi-host solve smoke (ISSUE 17): the tier1-multihost pytest lane
+# (2-rank hierarchical solve vs the single-process oracle + the real
+# 2-process CPU-mesh smoke), then the bench scenario at a small shape
+# asserting parity, the expected 2x4 mesh, and a per-cycle fence count
+# that matches the solve's step loop (one barrier per scan step).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m multihost -p no:cacheprovider -p no:xdist -p no:randomly
+mh=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import bench
+print(json.dumps(bench._measure_multihost(
+    num_jobs=96, num_nodes=64)))
+PY
+)
+python - "$mh" <<'PY'
+import json
+import sys
+
+doc = json.loads(sys.argv[1])
+assert doc["parity_with_single_process"], (
+    f"multi-host solve diverged from the single-process oracle: {doc}")
+assert doc["mesh"] == "2x4", (
+    f"expected a 2-process x 4-device mesh, got {doc['mesh']}: {doc}")
+assert doc["fence_count_per_cycle"] > 0, (
+    f"the hierarchical solve never fenced — it did not actually run "
+    f"the cross-process merge: {doc}")
+assert doc["warm_cycle_s"] < doc["cold_cycle_s"] * 2, (
+    f"warm multi-host cycle slower than 2x cold (jit cache broken?): "
+    f"{doc}")
+print(f"TIER1_MULTIHOST_OK mesh={doc['mesh']} "
+      f"warm_cycle_s={doc['warm_cycle_s']} "
+      f"decisions_per_sec={doc['decisions_per_sec']} "
+      f"fence_share={doc['fence_share']} "
+      f"placed={doc['placed']}")
 PY
