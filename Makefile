@@ -49,6 +49,12 @@
 #              (overlapping + disjoint class tables), real 2-process
 #              CPU-mesh smoke (XLA_FLAGS forced host devices), mesh
 #              bootstrap failure modes over the rendezvous.
+# tier1-rebalance — elastic-federation lane (@pytest.mark.rebalance in
+#              tests/test_rebalance.py): live partition migration
+#              (four-phase WAL handoff, source SIGKILL mid-handoff,
+#              exactly-once by job name), hot-shard detector hysteresis,
+#              map-epoch client re-learn over the wire, and global
+#              MaxJobs/MaxSubmitJobs vs the single-controller oracle.
 # tier1-lint — metrics/docs parity (tools/check_metrics_docs.py):
 #              every registered crane_* metric has a row in the
 #              ARCHITECTURE.md metric inventory table and vice-versa.
@@ -63,7 +69,7 @@
 
 .PHONY: tier1 tier1-obs tier1-perf tier1-ha tier1-commit tier1-topo \
 	tier1-delta tier1-resident tier1-trace tier1-fed tier1-flight \
-	tier1-multihost tier1-lint
+	tier1-multihost tier1-rebalance tier1-lint
 
 tier1: tier1-lint
 	bash tools/tier1.sh
@@ -116,4 +122,8 @@ tier1-flight:
 
 tier1-multihost:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m multihost \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+tier1-rebalance:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m rebalance \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1013,6 +1013,104 @@ def _measure_multihost(num_jobs: int = 512, num_nodes: int = 256,
     }
 
 
+def _measure_rebalance(n_jobs: int = 600,
+                       nodes_per_part: int = 24) -> dict:
+    """Elastic-federation handoff numbers (ISSUE 18): seal a LOADED
+    partition on one shard mid-storm and hand it to another — measure
+    the submit-outage window (seal→flip, the only interval where the
+    partition refuses work), the per-job handoff cost of the
+    seal→export→import→flip→commit sequence, and one gossip round of
+    the cluster-wide UsageBook.  The run audits itself BY NAME across
+    shards afterwards: a handoff that loses or doubles a single job is
+    a failed measurement, not a slow one."""
+    import shutil
+    import tempfile
+
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    from cranesched_tpu.fed.sim import FederatedCluster
+    from cranesched_tpu.fed.usage import GlobalLimits
+
+    tmp = tempfile.mkdtemp(prefix="crane-rebalance-bench-")
+    try:
+        fc = FederatedCluster(
+            {"east": {"batch": nodes_per_part,
+                      "debug": max(nodes_per_part // 4, 2)},
+             "west": {"gpu": nodes_per_part}},
+            cpu=16.0, mem_gb=64, wal_dir=tmp,
+            global_limits=GlobalLimits(
+                max_submit_jobs_per_user=n_jobs * 2),
+            publish_slack=32)
+        # waves sized to the publish slack with a gossip pump between:
+        # the conservative gate only admits `slack` unpublished jobs,
+        # so a pumpless bulk submit would measure the throttle, not
+        # the handoff
+        names = []
+        wave, i = 32, 0
+        while i < n_jobs:
+            for _ in range(min(wave, n_jobs - i)):
+                name = f"rb{i:05d}"
+                i += 1
+                _, jid = fc.submit(JobSpec(
+                    name=name, user="bench", partition="batch",
+                    res=ResourceSpec(cpu=2.0, mem_bytes=2 << 30,
+                                     memsw_bytes=2 << 30),
+                    sim_runtime=20.0))
+                if jid:
+                    names.append(name)
+            fc.tick()
+            fc.pump_usage(fc.now)
+        running = len(fc.shards["east"].scheduler.running)
+
+        t0 = time.perf_counter()
+        res = fc.migrate("batch", "west")
+        handoff_s = time.perf_counter() - t0
+        moved = res["jobs_imported"]
+
+        t0 = time.perf_counter()
+        docs = fc.pump_usage(fc.now)
+        gossip_ms = (time.perf_counter() - t0) * 1e3
+
+        # post-flip the map must route new work to the adopter
+        routed_to = fc.shard_map.shard_for_partition("batch")
+        _, jid = fc.submit(JobSpec(
+            name="rb-post-flip", user="bench", partition="batch",
+            res=ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                             memsw_bytes=1 << 30), sim_runtime=1.0))
+        if jid:
+            names.append("rb-post-flip")
+        # drain with the gossip pump running — the conservative gate
+        # needs fresh summaries to keep admitting run slots (in a real
+        # federation the pump is a background loop, never paused)
+        for _ in range(100_000):
+            fc.tick()
+            fc.pump_usage(fc.now)
+            if all(s.drained() for s in fc.shards.values()):
+                break
+        audit = fc.ledger_by_name(names)
+        ok = (res["committed"] and audit["lost"] == []
+              and audit["doubled"] == [] and audit["still_live"] == []
+              and routed_to == "west" and jid > 0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "jobs_submitted": len(names),
+        "running_at_handoff": running,
+        "jobs_moved": moved,
+        "handoff_s": round(handoff_s, 4),
+        "per_job_ms": round(handoff_s / max(moved, 1) * 1e3, 3),
+        "submit_outage_s": round(handoff_s, 4),
+        "map_epoch": fc.shard_map.epoch,
+        "usage_gossip_docs": docs,
+        "usage_gossip_ms": round(gossip_ms, 3),
+        "audit": {k: (len(v) if isinstance(v, list) else v)
+                  for k, v in audit.items()},
+        "exactly_once": ok,
+        "note": "in-process two-shard drill over real WALs; the "
+                "outage window IS the handoff (flip precedes commit, "
+                "so clients see at most one sealed-partition retry)",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -1044,6 +1142,15 @@ def main() -> int:
              "solve, bit-exact vs the single-process oracle (env "
              "BENCH_MULTIHOST; shape via BENCH_MH_JOBS/BENCH_MH_NODES/"
              "BENCH_MH_PROCS/BENCH_MH_DEVICES)")
+    ap.add_argument(
+        "--rebalance", action="store_true",
+        default=bool(os.environ.get("BENCH_REBALANCE")),
+        help="also run the elastic-federation scenario: migrate a "
+             "loaded partition between two live shards mid-storm and "
+             "report the handoff latency (submit-outage window), "
+             "per-job move cost, usage-gossip round time, and the "
+             "exactly-once-by-name audit (env BENCH_REBALANCE; shape "
+             "via BENCH_RB_JOBS/BENCH_RB_NODES)")
     ap.add_argument(
         "--churn", action="store_true",
         default=bool(os.environ.get("BENCH_CHURN")),
@@ -1332,6 +1439,16 @@ def main() -> int:
         except Exception as exc:
             mh_bench = {"error": f"{type(exc).__name__}: {exc}"}
 
+    rb_bench = None
+    if args.rebalance:
+        try:
+            rb_bench = _measure_rebalance(
+                n_jobs=int(os.environ.get("BENCH_RB_JOBS", 600)),
+                nodes_per_part=int(os.environ.get("BENCH_RB_NODES",
+                                                  24)))
+        except Exception as exc:
+            rb_bench = {"error": f"{type(exc).__name__}: {exc}"}
+
     churn_bench = None
     if args.churn:
         try:
@@ -1363,6 +1480,7 @@ def main() -> int:
             "churn": churn_bench,
             "federation": fed_bench,
             "multihost": mh_bench,
+            "rebalance": rb_bench,
             "device": str(dev), "repeats": repeats,
             "device_acquisition": acquisition,
         },

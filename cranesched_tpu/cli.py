@@ -658,13 +658,19 @@ def cmd_cinfo(args) -> int:
         if fed is None:
             return 1
         res = fed.cluster(max_staleness=args.max_staleness)
-        rows = [(shard, n.name, ",".join(n.partitions), n.state,
+        # per-shard map epochs: a skew across the column is a live
+        # migration mid-flip (the lagging shard re-learns on its next
+        # stamped reply)
+        epochs = fed.map_epochs()
+        rows = [(shard, epochs.get(shard, "-"), n.name,
+                 ",".join(n.partitions), n.state,
                  f"{n.cpu_avail:g}/{n.cpu_total:g}",
                  f"{n.mem_avail >> 30}G/{n.mem_total >> 30}G",
                  n.running_jobs)
                 for shard, reply in res for n in reply.nodes]
-        print(_fmt_table(rows, ("SHARD", "NODE", "PARTITIONS", "STATE",
-                                "CPU(A/T)", "MEM(A/T)", "JOBS")))
+        print(_fmt_table(rows, ("SHARD", "EPOCH", "NODE", "PARTITIONS",
+                                "STATE", "CPU(A/T)", "MEM(A/T)",
+                                "JOBS")))
         _fed_footer(res)
         fed.close()
         return 1 if res.errors else 0
@@ -681,6 +687,65 @@ def cmd_cinfo(args) -> int:
     print(_fmt_table(rows, ("NODE", "PARTITIONS", "STATE", "CPU(A/T)",
                             "MEM(A/T)", "JOBS")))
     return 0
+
+
+def cmd_cfed(args) -> int:
+    """Federation admin (``cfed``): the routing map with per-shard map
+    epochs, cluster-wide usage gossip, and live partition migration."""
+    import json as _json
+    fed = _fed_connect(args)
+    if fed is None:
+        return 1
+    try:
+        action = getattr(args, "fed_cmd", None) or "map"
+        if action == "migrate":
+            reply = fed.migrate(args.partition, args.dest)
+            if not reply.ok:
+                print(f"cfed migrate: {reply.error}", file=sys.stderr)
+                return 1
+            print(f"migrated {args.partition} -> {args.dest}  "
+                  f"mid={reply.mid}  jobs={reply.jobs_moved}  "
+                  f"map_epoch={reply.map_epoch}")
+            return 0
+        if action == "usage":
+            res = fed.usage()
+            rows = []
+            for shard, reply in res:
+                if not reply.ok:
+                    print(f"# shard {shard}: {reply.error}",
+                          file=sys.stderr)
+                    continue
+                doc = _json.loads(reply.payload)
+                for kind, table in (("user", doc.get("user", {})),
+                                    ("acct", doc.get("acct", {}))):
+                    for name, c in sorted(table.items()):
+                        rows.append((shard, kind, name,
+                                     c.get("jobs", 0),
+                                     c.get("submit_jobs", 0),
+                                     reply.durable_seq))
+            print(_fmt_table(rows, ("SHARD", "KIND", "NAME", "RUNNING",
+                                    "SUBMITTED", "DURABLE_SEQ")))
+            return 1 if res.errors else 0
+        # default: the map, one row per shard, with its own epoch
+        res = fed.shard_maps()
+        rows = []
+        for shard, reply in res:
+            own = next((s for s in reply.shards if s.name == shard),
+                       None)
+            rows.append((shard, reply.map_epoch,
+                         ",".join(own.partitions) if own else "-",
+                         own.address if own else "-"))
+        print(_fmt_table(rows, ("SHARD", "MAP_EPOCH", "PARTITIONS",
+                                "ADDRESS")))
+        for shard, err in sorted(res.errors.items()):
+            print(f"# shard {shard}: UNAVAILABLE ({err})",
+                  file=sys.stderr)
+        return 1 if res.errors else 0
+    except ValueError as exc:
+        print(f"cfed: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        fed.close()
 
 
 def cmd_ccancel(args) -> int:
@@ -1549,6 +1614,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "(blocks/switches, free nodes, fragmentation)")
     _fed_flags(p)
     p.set_defaults(func=cmd_cinfo)
+
+    p = sub.add_parser("cfed",
+                       help="federation admin: shard map + map epochs, "
+                            "usage gossip, live partition migration")
+    fed_sub = p.add_subparsers(dest="fed_cmd")
+    pm = fed_sub.add_parser("map", help="routing table with per-shard "
+                                        "map epochs (the default)")
+    pm.set_defaults(func=cmd_cfed)
+    pu = fed_sub.add_parser("usage",
+                            help="cluster-wide usage gossip summaries")
+    pu.set_defaults(func=cmd_cfed)
+    pg = fed_sub.add_parser(
+        "migrate",
+        help="live-migrate a partition to another shard (drains, "
+             "hands off pending+running jobs, flips the map epoch)")
+    pg.add_argument("partition")
+    pg.add_argument("dest")
+    pg.set_defaults(func=cmd_cfed)
+    p.set_defaults(func=cmd_cfed)
 
     p = sub.add_parser("ccancel", help="cancel jobs")
     p.add_argument("job_ids", nargs="+", type=int)

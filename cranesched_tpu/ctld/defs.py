@@ -335,6 +335,12 @@ class Job:
     # accounting free symmetric even if the QoS is deleted mid-run)
     run_usage_taken: bool = dataclasses.field(
         default=False, repr=False, compare=False)
+    # global (federation-wide) run slot reserved at admission but not
+    # yet converted by the running-dict hook — batch commits check the
+    # whole set before any insert, so the gate must see earlier
+    # same-cycle admissions through these reservations
+    global_run_reserved: bool = dataclasses.field(
+        default=False, repr=False, compare=False)
     priority: float = 0.0
     # topology placement record (topo/): the leaf block name when the
     # gang landed inside one block, "" otherwise; cross_block marks the

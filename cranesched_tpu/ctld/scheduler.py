@@ -482,6 +482,14 @@ class JobScheduler:
         self.accounts = accounts
         self.account_meta = (AccountMetaContainer(meta.layout)
                              if accounts is not None else None)
+        # cluster-wide accounting (fed/usage.py UsageBook): conservative
+        # global MaxJobs/MaxSubmitJobs gate + fair-share service input.
+        # None = per-shard limits only (single-controller behavior).
+        self.global_usage = None
+        # live partition migration (fed/rebalance.py): a sealed
+        # partition stops admitting — its jobs are mid-handoff to
+        # another shard and a new local submit would be stranded
+        self.sealed_partitions: set[str] = set()
         self.licenses = LicenseManager()
         # submit hook (the reference's Lua JobSubmitLuaScript seam,
         # LuaJobHandler.h:39: rewrite the spec or reject with a message):
@@ -506,6 +514,7 @@ class JobScheduler:
         self._noop_fp: tuple | None = None
         self._noop_edge: float = float("inf")
         self._cycle_fp0: tuple | None = None
+        self._cycle_usage_denied0: int = 0
         self._skip_trace: dict | None = None
         # PendingTable row indexes aligned with the in-flight cycle's
         # candidates/ordered lists (the vectorized row-build gathers)
@@ -752,11 +761,20 @@ class JobScheduler:
             self._alloc_only.add(job_id)
         self._run_epoch += 1
         _MET_RUNNING.set(len(self.running))
+        if self.global_usage is not None:
+            self.global_usage.note_run(job.spec.user, job.spec.account, 1)
+            if job.global_run_reserved:
+                # admission's held slot becomes the real running count
+                self.global_usage.unreserve_run(job.spec.user,
+                                                job.spec.account)
+                job.global_run_reserved = False
 
     def _on_running_del(self, job_id: int, job: Job) -> None:
         self._alloc_only.discard(job_id)
         self._run_epoch += 1
         _MET_RUNNING.set(len(self.running))
+        if self.global_usage is not None:
+            self.global_usage.note_run(job.spec.user, job.spec.account, -1)
 
     def _dep_cols(self, job: Job) -> tuple[float, bool]:
         """``(dep_ready_time, never)`` table columns mirroring
@@ -843,6 +861,14 @@ class JobScheduler:
         if not self.config.incremental:
             return
         if self.config.preempt_mode != "off" and self.accounts is not None:
+            return
+        if (self.global_usage is not None
+                and self.global_usage.denied
+                != self._cycle_usage_denied0):
+            # a candidate was refused by the cluster-wide usage gate
+            # this cycle; that gate's answer depends on gossip state
+            # (publish throttle, peer summaries) no epoch tracks —
+            # the next cycle may well place it
             return
         fp = self._cycle_fp0
         if fp is None or self._cycle_fingerprint() != fp:
@@ -965,6 +991,8 @@ class JobScheduler:
         part = self.meta.partitions.get(spec.partition)
         if part is None or not part.account_allowed(spec.account):
             return 0
+        if spec.partition in self.sealed_partitions:
+            return 0  # mid-migration: the successor map owns it now
         # gangs beyond the configured bound (or the partition size) can
         # never be placed — reject at submit rather than leaving the job
         # pending forever with a transient-looking reason
@@ -1018,6 +1046,15 @@ class JobScheduler:
                 if err:
                     return 0
                 qos_name, qos_priority = qos.name, qos.priority
+        if self.global_usage is not None:
+            # federation-wide MaxSubmitJobs (fed/usage.py): conservative
+            # under bounded staleness — deny-early, never overshoot
+            if self.global_usage.check_submit(spec.user, spec.account):
+                if self.account_meta is not None and qos_name:
+                    self.account_meta.free_submit(
+                        spec.user, spec.account, qos_name)
+                return 0
+            self.global_usage.note_submit(spec.user, spec.account)
 
         job_id = self._next_job_id
         self._next_job_id += 1
@@ -1571,22 +1608,37 @@ class JobScheduler:
         take is recorded on the job so the free stays symmetric even if
         the QoS is deleted/re-created while the job runs."""
         job.run_usage_taken = False
-        if self.account_meta is None or not job.qos_name:
-            return True
-        qos = self.accounts.qos.get(job.qos_name)
-        if qos is None:
-            return True
-        err = self.account_meta.check_and_malloc_run(
-            job.spec.user, job.spec.account, qos, job.spec)
-        if not err:
-            job.run_usage_taken = True
-        return not err
+        gu = self.global_usage
+        if gu is not None and gu.check_run(job.spec.user,
+                                           job.spec.account):
+            # federation-wide MaxJobs: the job stays pending
+            return False
+        if self.account_meta is not None and job.qos_name:
+            qos = self.accounts.qos.get(job.qos_name)
+            if qos is not None:
+                err = self.account_meta.check_and_malloc_run(
+                    job.spec.user, job.spec.account, qos, job.spec)
+                if err:
+                    return False
+                job.run_usage_taken = True
+        if gu is not None:
+            # hold the slot NOW: batch commits check every candidate
+            # before any lands in the running dict, so later same-cycle
+            # checks must see this admission (the dict hook converts
+            # the reservation into the real count)
+            gu.reserve_run(job.spec.user, job.spec.account)
+            job.global_run_reserved = True
+        return True
 
     def _free_run_limits(self, job: Job) -> None:
         if self.account_meta is not None and job.run_usage_taken:
             self.account_meta.free_run(job.spec.user, job.spec.account,
                                        job.qos_name, job.spec)
             job.run_usage_taken = False
+        if self.global_usage is not None and job.global_run_reserved:
+            self.global_usage.unreserve_run(job.spec.user,
+                                            job.spec.account)
+            job.global_run_reserved = False
 
     def _finalize_terminal(self, job: Job) -> None:
         """Full terminal processing: archive + fire dependency events +
@@ -1625,6 +1677,9 @@ class JobScheduler:
                 and job.array_parent_id is None):
             self.account_meta.free_submit(job.spec.user, job.spec.account,
                                           job.qos_name)
+        if self.global_usage is not None and job.array_parent_id is None:
+            self.global_usage.note_release_submit(job.spec.user,
+                                                  job.spec.account)
         self.history[job.job_id] = job
         if self.archive is not None:
             # archive BEFORE the WAL tombstone: once both exist the job
@@ -2303,6 +2358,9 @@ class JobScheduler:
             return self._skip_cycle(t0, now, "fingerprint")
         self._cycle_fp0 = fp
         self._noop_fp = None
+        self._cycle_usage_denied0 = (self.global_usage.denied
+                                     if self.global_usage is not None
+                                     else 0)
 
         self.stats["cycles"] += 1
         _MET_CYCLES.inc()
@@ -3650,8 +3708,26 @@ class JobScheduler:
             run_time=jnp.asarray(run_time),
             valid=r_valid)
 
+        extra_service = None
+        if self.global_usage is not None:
+            remote = self.global_usage.remote_account_jobs()
+            if remote:
+                # cluster-wide fair-share: remote running-job counts per
+                # account feed the service sum (fed/usage.py); accounts
+                # the gossip names but this shard has never seen get no
+                # dense index yet — they have no local jobs to sort, so
+                # their remote burn cannot change this shard's order
+                es = np.zeros(num_accounts, np.float32)
+                for acct, jobs in remote.items():
+                    idx = self._account_index.get(acct)
+                    if idx is not None and idx < num_accounts:
+                        es[idx] = float(jobs)
+                if es.any():
+                    extra_service = jnp.asarray(es)
+
         pri = np.asarray(multifactor_priority(
-            pending, running, self.config.priority_weights, num_accounts))
+            pending, running, self.config.priority_weights, num_accounts,
+            extra_service=extra_service))
         order = np.asarray(priority_order(jnp.asarray(pri)))
         order = order[order < len(candidates)]  # drop -inf padding rows
         for job, p in zip(candidates, pri):
@@ -4037,6 +4113,13 @@ class JobScheduler:
                     and job.array_parent_id is None):
                 self.account_meta.restore_submit(
                     job.spec.user, job.spec.account, job.qos_name)
+            if not job.status.is_terminal and (
+                    self.global_usage is not None
+                    and job.array_parent_id is None):
+                # restore without re-checking: the slot was legitimately
+                # admitted before the crash (fed/usage.py note_submit)
+                self.global_usage.note_submit(job.spec.user,
+                                              job.spec.account)
             if job.status.is_terminal:
                 self.history[job_id] = job
                 if self.archive is not None and job_id not in \

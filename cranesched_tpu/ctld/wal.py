@@ -478,9 +478,31 @@ class WriteAheadLog:
             if after_seq and rec.get("seq", 0) <= after_seq:
                 continue
             fed = rec.get("fed")
-            if fed is None:
-                continue
+            if fed is None or "lease_id" not in fed:
+                continue  # migration records replay separately
             state[str(fed.get("lease_id", ""))] = (rec["ev"], fed)
+        return state
+
+    @staticmethod
+    def replay_migrations(path: str) -> dict[str, dict]:
+        """Reconstruct partition-migration state: mid -> merged payload
+        with ``ev`` = the LAST recorded phase (``fed_migrate_begin`` /
+        ``fed_migrate_import`` / ``fed_migrate_commit`` /
+        ``fed_migrate_abort``).  Payload fields accumulate across the
+        phases so a ``commit`` entry still carries the ``begin``
+        record's job_ids — recovery needs them to drop migrated-away
+        jobs the ordinary job replay just resurrected."""
+        state: dict[str, dict] = {}
+        for rec in WriteAheadLog._iter_records(path):
+            fed = rec.get("fed")
+            if fed is None or "mid" not in fed:
+                continue
+            entry = state.setdefault(str(fed["mid"]), {})
+            entry.update(fed)
+            entry["ev"] = rec["ev"]
+            # first-record seq: imports re-apply in arrival order on
+            # recovery so adopted node ids re-number identically
+            entry.setdefault("seq", rec.get("seq", 0))
         return state
 
     @staticmethod
@@ -546,18 +568,28 @@ class WriteAheadLog:
         # federation lease records: keep each lease's last record unless
         # it is resolved (confirmed or released) — dropping an
         # unresolved fed_reserve would resurrect its nodes on recovery
-        # while the arbiter may still confirm against the lease
+        # while the arbiter may still confirm against the lease.
+        # Migration records key by mid.  ``fed_migrate_abort`` is the
+        # only droppable migration state: a commit must survive forever
+        # on the source (it is what filters the migrated-away jobs out
+        # of replay) and an import must survive on the destination (the
+        # source's crash recovery resolves begin-without-commit by
+        # asking whether the import happened).
         fed_last: dict[str, dict] = {}
         for rec in self._iter_records(self.path):
             fed = rec.get("fed")
-            if fed is not None:
-                fed_last[str(fed.get("lease_id", ""))] = rec
-        for lease_id in sorted(fed_last):
-            rec = fed_last[lease_id]
-            if not segments and rec["ev"] in ("fed_confirm",
-                                              "fed_release"):
+            if fed is None:
                 continue
-            keep.append((lease_id, json.dumps(
+            key = (str(fed["lease_id"]) if "lease_id" in fed
+                   else "mig:" + str(fed.get("mid", "")))
+            fed_last[key] = rec
+        for key in sorted(fed_last):
+            rec = fed_last[key]
+            if not segments and rec["ev"] in ("fed_confirm",
+                                              "fed_release",
+                                              "fed_migrate_abort"):
+                continue
+            keep.append((key, json.dumps(
                 rec, separators=(",", ":"))))
         self._fh.close()
         tmp = self.path + ".tmp"

@@ -181,6 +181,36 @@ def main(argv=None) -> int:
         print(f"auth enabled (token table {cfg.auth_token_file}; "
               f"root + craned tokens inside)", flush=True)
 
+    shard_map = cfg.shard_map()
+    shard_name = cfg.shard_name
+    if shard_map is not None:
+        # federation plane: leases + live-migration WAL protocol ride
+        # on the scheduler (fed/shard.py self-attaches as .fed), and
+        # Federation: Limits: turns on the cluster-wide UsageBook
+        from cranesched_tpu.fed.shard import FedShardPlane
+        FedShardPlane(scheduler, shard_name)
+        limits = cfg.global_limits()
+        if limits is not None:
+            from cranesched_tpu.fed.usage import UsageBook
+            # PublishSlack = admissions a shard may run ahead of its
+            # last gossiped summary (the conservative gate subtracts
+            # (shards-1)*slack from every global limit); 8 absorbs a
+            # burst of submits inside one gossip interval
+            slack = int((cfg.federation.get("Limits") or {})
+                        .get("PublishSlack", 8))
+            scheduler.global_usage = UsageBook(
+                shard_name, limits,
+                n_shards=len(shard_map.shards),
+                publish_slack=slack,
+                seq_source=lambda: (scheduler.wal.durable_seq
+                                    if scheduler.wal is not None
+                                    else 0))
+        print(f"federation shard {shard_name!r}: "
+              f"{len(shard_map.shards)} shards, map epoch "
+              f"{shard_map.epoch}"
+              + (", global limits on" if limits is not None else ""),
+              flush=True)
+
     metrics_port = (args.metrics_port if args.metrics_port is not None
                     else cfg.metrics_port)
     address = args.listen or cfg.listen
@@ -188,6 +218,7 @@ def main(argv=None) -> int:
                          cycle_interval=args.cycle_interval,
                          dispatcher=dispatcher, auth=auth, tls=tls,
                          metrics_port=metrics_port,
+                         shard_name=shard_name, shard_map=shard_map,
                          standby=args.ha_standby,
                          peer_address=args.ha_peer)
     print(f"cranectld [{cfg.cluster_name}] listening on port {port} "

@@ -79,7 +79,7 @@ class FederatedClient:
         shard_map = ShardMap.from_doc([
             {"name": s.name, "partitions": list(s.partitions),
              "address": s.address, "followers": list(s.followers)}
-            for s in reply.shards])
+            for s in reply.shards], epoch=reply.map_epoch)
         return cls(shard_map, token=token, tls=tls, timeout=timeout)
 
     def close(self) -> None:
@@ -123,3 +123,32 @@ class FederatedClient:
     def events(self, max_staleness: float = 0.0, **kw) -> FanoutResult:
         return self._each(
             lambda c: c.query_events(max_staleness=max_staleness, **kw))
+
+    # -- elastic federation: map epochs, usage gossip, migration --
+
+    def shard_maps(self) -> FanoutResult:
+        """Each shard's OWN view of the routing table.  During a live
+        migration the per-shard ``map_epoch`` values skew for a moment;
+        cfed/cinfo surface them so an operator can see a flip settle."""
+        return self._each(lambda c: c.query_shard_map())
+
+    def map_epochs(self) -> dict[str, int]:
+        """shard -> the map epoch it currently routes by (absent shards
+        were unreachable)."""
+        return {shard: reply.map_epoch
+                for shard, reply in self.shard_maps()}
+
+    def usage(self) -> FanoutResult:
+        """Every shard's usage-gossip summary (cluster-wide accounting)."""
+        return self._each(lambda c: c.fetch_usage())
+
+    def migrate(self, partition: str, dest: str):
+        """Drive a live migration: dial the partition's SOURCE shard —
+        the source owns the four-phase protocol end to end."""
+        source = self.shard_map.shard_for_partition(partition)
+        if not source:
+            raise ValueError(f"partition {partition!r} not in the "
+                             f"shard map")
+        if source not in self._clients:
+            raise ValueError(f"no client for source shard {source!r}")
+        return self._clients[source].migrate_partition(partition, dest)

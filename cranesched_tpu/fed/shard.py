@@ -33,6 +33,39 @@ Recovery: :meth:`recover` replays ``fed_*`` records after the normal
 job replay.  Leases whose last record is ``fed_reserve`` are released
 (durable ``fed_release`` tombstone) — their gang was never committed
 here, and the arbiter's own retry logic re-places it from scratch.
+
+Live partition migration (fed/rebalance.py drives it) adds a second
+WAL protocol on the same plane.  A partition moves shard-to-shard in
+four durable phases, each its own record:
+
+``fed_migrate_begin`` (source)
+    The partition is sealed — local submits refuse, arbiter leases on
+    its nodes release — and the intent (mid, dest, job_ids) is durable.
+
+``fed_migrate_import`` (dest)
+    The ONLY record that creates jobs on the destination.  The whole
+    handoff payload lands in one WAL group: node inventory adopted by
+    NAME (ids are shard-local), every pending/running job re-created
+    under a fresh dest-local id, then the import record.  A crash
+    before the group's fsync imports nothing; after, everything —
+    never half a partition.
+
+``fed_migrate_commit`` (source)
+    Written once the dest durably holds the jobs and the successor map
+    is live.  The source then DROPS the migrated jobs — resources,
+    licenses, run limits, submit slots freed; no terminal stamps, this
+    is removal, not completion — and marks the partition's nodes dead.
+    ``compact`` keeps this record forever: it is what filters the
+    migrated jobs out of every future source replay.
+
+``fed_migrate_abort`` (source)
+    The handoff never reached the dest: unseal, keep everything.
+
+A source SIGKILL mid-handoff leaves a begin without commit/abort;
+:meth:`recover_migrations` surfaces it and the coordinator resolves by
+asking the dest :meth:`has_import` — imported means commit (the jobs
+live there), not imported means abort (they never left).  Exactly one
+shard ends up owning every job either way.
 """
 
 from __future__ import annotations
@@ -41,8 +74,14 @@ import dataclasses
 
 import numpy as np
 
-from cranesched_tpu.ctld.defs import JobSpec, JobStatus, PendingReason
+from cranesched_tpu.ctld.defs import (
+    DEP_NEVER,
+    JobSpec,
+    JobStatus,
+    PendingReason,
+)
 from cranesched_tpu.ctld.meta import ResReduceEvent
+from cranesched_tpu.ctld.wal import _job_from_dict, _job_to_dict
 from cranesched_tpu.obs import REGISTRY as _OBS
 
 _MET_LEASES = _OBS.counter(
@@ -51,6 +90,9 @@ _MET_LEASES = _OBS.counter(
 _MET_REVOKED = _OBS.counter(
     "crane_fed_leases_revoked_total",
     "arbiter node leases released, expired, or dropped by recovery")
+_MET_MIG_JOBS = _OBS.counter(
+    "crane_fed_migrated_jobs_total",
+    "jobs adopted by this shard through live partition migration")
 
 
 @dataclasses.dataclass
@@ -78,6 +120,12 @@ class FedShardPlane:
         scheduler.shard_name = shard_name
         scheduler.fed = self
         self.leases: dict[str, Lease] = {}
+        #: mid -> dest-local job ids adopted (the source's crash
+        #: recovery asks :meth:`has_import` to resolve a bare begin)
+        self.imports: dict[str, list[int]] = {}
+        #: partitions this shard handed away (their nodes stay in meta,
+        #: dead, so shard-local node ids never renumber)
+        self.migrated_away: set[str] = set()
 
     # -- reserve --
 
@@ -302,5 +350,339 @@ class FedShardPlane:
             dropped += 1
         return dropped
 
+    # ------------------------------------------------------------------
+    # live partition migration (the four-phase WAL protocol; see the
+    # module docstring — fed/rebalance.py MigrationCoordinator drives)
+    # ------------------------------------------------------------------
+
+    def partition_jobs(self, partition: str) -> list[int]:
+        """Live (pending + running) job ids of one partition."""
+        sched = self.scheduler
+        ids = [jid for jid, j in sched.pending.items()
+               if j.spec.partition == partition]
+        ids += [jid for jid, j in sched.running.items()
+                if j.spec.partition == partition]
+        return sorted(ids)
+
+    def seal_partition(self, mid: str, partition: str, dest: str,
+                       now: float) -> list[int]:
+        """Phase one on the SOURCE: stop admitting into ``partition``
+        and make the intent durable.  Local submits into a sealed
+        partition return 0 (the successor map owns it), and any arbiter
+        lease on its nodes releases — the gang re-places against the
+        successor map.  Returns the job ids that will travel."""
+        sched = self.scheduler
+        if partition not in sched.meta.partitions:
+            raise ValueError(f"partition {partition!r} not owned by "
+                             f"shard {self.shard!r}")
+        if partition in sched.sealed_partitions:
+            raise ValueError(f"partition {partition!r} already sealed "
+                             "(migration in flight)")
+        for lid in [lid for lid, lease in self.leases.items()
+                    if lease.partition == partition]:
+            self.release_lease(lid, now, detail="partition migrating")
+        sched.sealed_partitions.add(partition)
+        job_ids = self.partition_jobs(partition)
+        if sched.wal is not None:
+            sched.wal.fed_event("fed_migrate_begin", {
+                "mid": str(mid), "partition": partition, "dest": dest,
+                "job_ids": job_ids})
+        sched.events.emit(
+            "fed_migrate_begin", "info", time=now,
+            detail=f"mid={mid} part={partition} dest={dest} "
+                   f"jobs={len(job_ids)}")
+        return job_ids
+
+    def export_partition(self, mid: str, partition: str) -> dict:
+        """The handoff payload: partition metadata, node inventory, and
+        every live job.  Nodes and per-job placements travel by NAME —
+        node ids are shard-local and the dest assigns its own.  The
+        dispatch ring is empty by the time this runs (the caller holds
+        the shard lock and every committed dispatch drained before it
+        was taken), so the payload is the complete partition state."""
+        sched = self.scheduler
+        meta = sched.meta
+        part = meta.partitions.get(partition)
+        if part is None:
+            raise ValueError(f"partition {partition!r} not owned by "
+                             f"shard {self.shard!r}")
+        nodes = []
+        for nid in sorted(part.node_ids):
+            node = meta.nodes[nid]
+            nodes.append({"name": node.name,
+                          "total": [int(x) for x in node.total],
+                          "partitions": sorted(node.partitions)})
+        jobs = []
+        for jid in self.partition_jobs(partition):
+            job = sched.pending.get(jid) or sched.running.get(jid)
+            jobs.append({"job": _job_to_dict(job),
+                         "node_names": [meta.nodes[n].name
+                                        for n in job.node_ids]})
+        return {"mid": str(mid), "partition": partition,
+                "source": self.shard, "priority": part.priority,
+                "nodes": nodes, "jobs": jobs}
+
+    def import_partition(self, payload: dict, now: float
+                         ) -> tuple[list[int], list[int]]:
+        """Phase two on the DEST: adopt the partition in ONE WAL group.
+
+        Jobs are re-created under fresh dest-local ids (ascending in
+        source-id order, preserving relative queue age); running jobs
+        re-malloc their named nodes and re-enter the running set exactly
+        as :meth:`JobScheduler.recover` re-adopts survivors — the
+        physical tasks never stopped, only their controller moved.
+        Idempotent per mid: a retried handoff returns the first
+        import's ids.  Returns (job_ids, node_ids-added)."""
+        sched = self.scheduler
+        meta = sched.meta
+        mid = str(payload["mid"])
+        partition = str(payload["partition"])
+        if mid in self.imports:
+            return list(self.imports[mid]), []
+        if partition not in meta.partitions:
+            meta.add_partition(partition,
+                               priority=int(payload.get("priority", 0)))
+        new_nodes: list[int] = []
+        for doc in payload.get("nodes", []) or []:
+            nid = meta._name_to_id.get(doc["name"])
+            if nid is None:
+                node = meta.add_node(
+                    doc["name"], np.asarray(doc["total"], np.int32),
+                    partitions=doc.get("partitions") or (partition,))
+                nid = node.node_id
+                meta.craned_up(nid)
+                new_nodes.append(nid)
+        entries = sorted(payload.get("jobs", []) or [],
+                         key=lambda e: e["job"]["job_id"])
+        idmap: dict[int, int] = {}
+        for entry in entries:
+            idmap[int(entry["job"]["job_id"])] = sched._next_job_id
+            sched._next_job_id += 1
+        wal = sched.wal
+        imported: list[int] = []
+        try:
+            if wal is not None:
+                wal.begin_batch()
+            for entry in entries:
+                job = _job_from_dict(entry["job"])
+                job.job_id = idmap[int(entry["job"]["job_id"])]
+                self._remap_job(job, idmap,
+                                entry.get("node_names") or [])
+                if job.status in (JobStatus.RUNNING,
+                                  JobStatus.SUSPENDED):
+                    if not meta.malloc_resource(job.job_id,
+                                                job.node_ids,
+                                                sched._job_alloc(job)):
+                        raise ValueError(
+                            f"imported nodes cannot hold job "
+                            f"{entry['job']['job_id']} "
+                            f"(mid={mid}, part={partition})")
+                    sched.licenses.restore(job.spec.licenses or {})
+                    if sched.account_meta is not None and job.qos_name:
+                        sched.account_meta.restore_run(
+                            job.spec.user, job.spec.account,
+                            job.qos_name, job.spec)
+                        job.run_usage_taken = True
+                    sched.running[job.job_id] = job
+                    sched._ledger_add(job, now)
+                    if wal is not None:
+                        wal.job_started(job)
+                else:
+                    sched.pending[job.job_id] = job
+                    # waiting edges re-register so co-migrated
+                    # dependees still fire events on this shard
+                    for dep_id, v in job.dep_state.items():
+                        if v is None:
+                            sched._dependents.setdefault(
+                                dep_id, set()).add(job.job_id)
+                    if wal is not None:
+                        wal.job_submitted(job)
+                if (sched.account_meta is not None and job.qos_name
+                        and job.array_parent_id is None):
+                    sched.account_meta.restore_submit(
+                        job.spec.user, job.spec.account, job.qos_name)
+                if (sched.global_usage is not None
+                        and job.array_parent_id is None):
+                    sched.global_usage.note_submit(job.spec.user,
+                                                   job.spec.account)
+                if sched.jobtrace is not None:
+                    sched.jobtrace.stamp(job.job_id, job.requeue_count,
+                                         "migrated_in", now,
+                                         epoch=sched.fencing_epoch)
+                imported.append(job.job_id)
+                _MET_MIG_JOBS.inc()
+            if wal is not None:
+                # node inventory rides the import record: recovery must
+                # rebuild these meta entries BEFORE replaying the jobs
+                wal.fed_event("fed_migrate_import", {
+                    "mid": mid, "partition": partition,
+                    "source": str(payload.get("source", "")),
+                    "priority": int(payload.get("priority", 0)),
+                    "nodes": payload.get("nodes", []) or [],
+                    "job_ids": imported})
+        finally:
+            if wal is not None:
+                wal.commit_batch()
+        self.imports[mid] = list(imported)
+        sched.events.emit(
+            "fed_migrate_import", "info", time=now,
+            detail=f"mid={mid} part={partition} jobs={len(imported)} "
+                   f"nodes={len(new_nodes)}")
+        sched._kick()
+        return imported, new_nodes
+
+    def _remap_job(self, job, idmap: dict[int, int],
+                   node_names: list[str]) -> None:
+        """Rewrite every shard-local id in an imported job: placement
+        by node NAME, dependency/array edges through ``idmap``.  A
+        waiting dependency whose dependee did NOT co-migrate can never
+        fire here — it becomes DEP_NEVER (cross-shard dependencies are
+        out of contract, same as at submit routing)."""
+        meta = self.scheduler.meta
+        node_ids = []
+        for name in node_names:
+            nid = meta._name_to_id.get(name)
+            if nid is None:
+                raise ValueError(f"imported job placed on unknown "
+                                 f"node {name!r}")
+            node_ids.append(nid)
+        job.node_ids = node_ids
+        job.alloc_cache = None
+        if job.spec.dependencies:
+            job.spec = dataclasses.replace(job.spec, dependencies=tuple(
+                dataclasses.replace(dep, job_id=idmap.get(dep.job_id,
+                                                          dep.job_id))
+                for dep in job.spec.dependencies))
+        dep_state = {}
+        for old_id, v in job.dep_state.items():
+            if old_id in idmap:
+                dep_state[idmap[old_id]] = v
+            elif v is None:
+                dep_state[old_id] = DEP_NEVER
+            else:
+                dep_state[old_id] = v  # resolved on the source: keep
+        job.dep_state = dep_state
+        if job.array_parent_id is not None:
+            job.array_parent_id = idmap.get(job.array_parent_id,
+                                            job.array_parent_id)
+        if job.array_children:
+            job.array_children = [idmap.get(c, c)
+                                  for c in job.array_children]
+
+    def has_import(self, mid: str) -> bool:
+        """Did this shard durably adopt handoff ``mid``?  The answer
+        the source's crash recovery keys commit-vs-abort on."""
+        return str(mid) in self.imports
+
+    def commit_migration(self, mid: str, partition: str,
+                         now: float) -> list[int]:
+        """Final phase on the SOURCE, once the dest holds the jobs and
+        the successor map is live: write the commit record, then DROP
+        the migrated jobs — free resources/licenses/limits/slots,
+        remove from the queues with no terminal stamps (removal, not
+        completion) — and mark the partition's nodes dead.  The
+        partition stays sealed forever here; compact keeps the commit
+        record forever so no future replay resurrects the jobs."""
+        sched = self.scheduler
+        meta = sched.meta
+        job_ids = self.partition_jobs(partition)
+        if sched.wal is not None:
+            sched.wal.fed_event("fed_migrate_commit", {
+                "mid": str(mid), "partition": partition,
+                "job_ids": job_ids})
+        for jid in job_ids:
+            job = sched.running.get(jid)
+            if job is not None:
+                meta.free_resource(jid, job.node_ids,
+                                   sched._job_alloc(job))
+                sched._ledger.remove(jid)
+                sched.licenses.free(job.spec.licenses or {})
+                sched._free_run_limits(job)
+                del sched.running[jid]
+            else:
+                job = sched.pending.pop(jid)
+            # the submit slot travels with the job (the dest restored
+            # its own at import)
+            if (sched.account_meta is not None and job.qos_name
+                    and job.array_parent_id is None):
+                sched.account_meta.free_submit(
+                    job.spec.user, job.spec.account, job.qos_name)
+            if (sched.global_usage is not None
+                    and job.array_parent_id is None):
+                sched.global_usage.note_release_submit(
+                    job.spec.user, job.spec.account)
+            sched._dependents.pop(jid, None)
+        part = meta.partitions.get(partition)
+        if part is not None:
+            for nid in sorted(part.node_ids):
+                if meta.nodes[nid].alive:
+                    meta.craned_down(nid)
+        self.migrated_away.add(partition)
+        sched.events.emit(
+            "fed_migrate_commit", "info", time=now,
+            detail=f"mid={mid} part={partition} "
+                   f"handed_off={len(job_ids)}")
+        return job_ids
+
+    def abort_migration(self, mid: str, partition: str,
+                        now: float) -> None:
+        """The handoff never reached the dest: unseal and keep
+        everything — the begin record is annulled durably."""
+        sched = self.scheduler
+        if sched.wal is not None:
+            sched.wal.fed_event("fed_migrate_abort", {
+                "mid": str(mid), "partition": partition})
+        sched.sealed_partitions.discard(partition)
+        sched.events.emit(
+            "fed_migrate_abort", "warning", time=now,
+            detail=f"mid={mid} part={partition}")
+
+    def recover_migrations(self, now: float) -> list[dict]:
+        """Post-replay migration cleanup (runs AFTER the caller already
+        filtered committed migrations' jobs out of the replay — see
+        ``WriteAheadLog.replay_migrations``):
+
+        * import records re-seed :attr:`imports` (the source may still
+          call :meth:`has_import`),
+        * commit records re-seal the partition and re-mark its nodes
+          dead,
+        * a begin with no commit/abort is returned UNRESOLVED — the
+          partition re-seals and the coordinator must resolve it
+          against the dest before it moves again.
+        """
+        sched = self.scheduler
+        if sched.wal is None:
+            return []
+        unresolved: list[dict] = []
+        state = sched.wal.replay_migrations(sched.wal.path)
+        for mid, entry in sorted(state.items()):
+            ev = entry.get("ev", "")
+            partition = str(entry.get("partition", ""))
+            if ev == "fed_migrate_import":
+                self.imports[mid] = list(entry.get("job_ids") or [])
+            elif ev == "fed_migrate_commit":
+                sched.sealed_partitions.add(partition)
+                self.migrated_away.add(partition)
+                part = sched.meta.partitions.get(partition)
+                if part is not None:
+                    for nid in sorted(part.node_ids):
+                        if sched.meta.nodes[nid].alive:
+                            sched.meta.craned_down(nid)
+            elif ev == "fed_migrate_begin":
+                sched.sealed_partitions.add(partition)
+                unresolved.append({"mid": mid, "partition": partition,
+                                   "dest": str(entry.get("dest", "")),
+                                   "job_ids": list(
+                                       entry.get("job_ids") or [])})
+                sched.events.emit(
+                    "fed_migrate_unresolved", "warning", time=now,
+                    detail=f"mid={mid} part={partition} "
+                           "(begin without commit/abort — resolving "
+                           "against the destination)")
+        return unresolved
+
     def stats(self) -> dict:
-        return {"shard": self.shard, "leases": len(self.leases)}
+        return {"shard": self.shard, "leases": len(self.leases),
+                "sealed": sorted(self.scheduler.sealed_partitions),
+                "migrated_away": sorted(self.migrated_away),
+                "imports": len(self.imports)}

@@ -23,14 +23,18 @@ from __future__ import annotations
 
 import threading
 
+import numpy as np
+
 from cranesched_tpu.craned.sim import SimCluster
 from cranesched_tpu.ctld.defs import JobSpec
 from cranesched_tpu.ctld.meta import MetaContainer
 from cranesched_tpu.ctld.scheduler import JobScheduler, SchedulerConfig
 from cranesched_tpu.ctld.wal import WriteAheadLog
 from cranesched_tpu.fed.arbiter import GangRequest, PlacementArbiter
+from cranesched_tpu.fed.rebalance import MigrationCoordinator
 from cranesched_tpu.fed.shard import FedShardPlane
 from cranesched_tpu.fed.shardmap import ShardMap, ShardSpec
+from cranesched_tpu.fed.usage import UsageBook
 from cranesched_tpu.ops.resources import ResourceLayout
 
 
@@ -39,19 +43,27 @@ class SimShard:
 
     def __init__(self, name: str, partitions: dict[str, int],
                  cpu: float = 16.0, mem_gb: int = 64,
-                 wal_path: str | None = None, config_kw=None):
+                 wal_path: str | None = None, config_kw=None,
+                 global_limits=None, n_shards: int = 1,
+                 publish_slack: int = 1):
         self.name = name
         self.partitions = dict(partitions)
         self.cpu = cpu
         self.mem_gb = mem_gb
         self.wal_path = wal_path
         self.config_kw = dict(config_kw or {})
+        self.global_limits = global_limits
+        self.n_shards = n_shards
+        self.publish_slack = publish_slack
         self.lock = threading.Lock()
         self.alive = True
         #: failure injection: die immediately after the NEXT successful
         #: lease (reserve durable, confirm never answered) — the
         #: arbiter's phase-two then hits a dead shard mid-gang
         self.crash_after_lease = False
+        #: bare fed_migrate_begin records found by the last recovery —
+        #: MigrationCoordinator.resolve settles them against the dest
+        self.unresolved_migrations: list[dict] = []
         self._fresh_wal = True
         self._build(now=0.0, replayed=None)
 
@@ -60,6 +72,9 @@ class SimShard:
     def _build(self, now: float, replayed) -> None:
         self.meta = MetaContainer(ResourceLayout())
         nid = 0
+        # native partitions build in sorted order, ALWAYS — including
+        # ones migrated away (their nodes go dead below, never absent),
+        # so shard-local node ids stay stable across every recovery
         for part in sorted(self.partitions):
             for i in range(self.partitions[part]):
                 self.meta.add_node(
@@ -71,10 +86,49 @@ class SimShard:
                     partitions=(part,))
                 self.meta.craned_up(nid)
                 nid += 1
+        migs = {}
+        if replayed is not None and self.wal_path is not None:
+            migs = WriteAheadLog.replay_migrations(self.wal_path)
+            # partitions adopted by live migration re-create their meta
+            # in import order (seq), AFTER the native nodes — the same
+            # append order the live import used, so node ids re-number
+            # identically and replayed placements stay valid
+            for entry in sorted(migs.values(),
+                                key=lambda e: e.get("seq", 0)):
+                if entry.get("ev") != "fed_migrate_import":
+                    continue
+                part = str(entry.get("partition", ""))
+                if part not in self.meta.partitions:
+                    self.meta.add_partition(
+                        part, priority=int(entry.get("priority", 0)))
+                for doc in entry.get("nodes", []) or []:
+                    if doc["name"] in self.meta._name_to_id:
+                        continue
+                    node = self.meta.add_node(
+                        doc["name"],
+                        np.asarray(doc["total"], np.int32),
+                        partitions=doc.get("partitions") or (part,))
+                    self.meta.craned_up(node.node_id)
+            # jobs handed off by a committed migration must NOT
+            # resurrect from their (non-terminal) job records: the
+            # commit record is the filter
+            for entry in migs.values():
+                if entry.get("ev") == "fed_migrate_commit":
+                    for jid in entry.get("job_ids") or []:
+                        replayed.pop(jid, None)
         kw = dict(self.config_kw)
         kw.setdefault("job_trace", True)
         kw.setdefault("job_trace_capacity", 65536)
         self.scheduler = JobScheduler(self.meta, SchedulerConfig(**kw))
+        if self.global_limits is not None:
+            # before recover: restored jobs must re-take their global
+            # submit slots (fed/usage.py)
+            self.scheduler.global_usage = UsageBook(
+                self.name, self.global_limits, n_shards=self.n_shards,
+                publish_slack=self.publish_slack,
+                seq_source=lambda: (self.scheduler.wal.durable_seq
+                                    if self.scheduler.wal is not None
+                                    else 0))
         if replayed is not None:
             self.scheduler.recover(replayed, now)
         if self.wal_path is not None:
@@ -86,8 +140,10 @@ class SimShard:
         self.sim.now = now
         self.sim.wire(self.scheduler)
         self.fed = FedShardPlane(self.scheduler, self.name)
+        self.unresolved_migrations = []
         if replayed is not None:
             self.fed.recover(now)
+            self.unresolved_migrations = self.fed.recover_migrations(now)
             # the craneds of a real shard still run the re-adopted
             # jobs; the simulated plane re-dispatches them instead
             for job in self.scheduler.running.values():
@@ -180,6 +236,59 @@ class ShardHandle:
         with self.shard.lock:
             return self.shard.scheduler.cancel(job_id, now)
 
+    # -- the migration surface (MigrationCoordinator endpoints) --
+
+    def seal(self, mid: str, partition: str, dest: str,
+             now: float) -> list[int]:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.seal_partition(mid, partition, dest,
+                                                 now)
+
+    def export(self, mid: str, partition: str) -> dict:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.export_partition(mid, partition)
+
+    def import_(self, payload: dict, now: float):
+        self._check()
+        with self.shard.lock:
+            imported, new_nodes = self.shard.fed.import_partition(
+                payload, now)
+            # the simulated node plane must mirror the adopted meta:
+            # craneds for the new nodes, re-dispatch for the running
+            # jobs (their physical tasks never stopped — a real craned
+            # re-registers; the sim re-arms their completions)
+            from cranesched_tpu.craned.sim import SimCraned
+            for nid in new_nodes:
+                self.shard.sim.craneds.setdefault(nid, SimCraned(nid))
+            for jid in imported:
+                job = self.shard.scheduler.running.get(jid)
+                if job is not None:
+                    self.shard.sim.dispatch(job, job.node_ids)
+            return imported, new_nodes
+
+    def commit(self, mid: str, partition: str, now: float) -> list[int]:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.commit_migration(mid, partition, now)
+
+    def abort(self, mid: str, partition: str, now: float) -> None:
+        self._check()
+        with self.shard.lock:
+            self.shard.fed.abort_migration(mid, partition, now)
+
+    def has_import(self, mid: str) -> bool:
+        self._check()
+        with self.shard.lock:
+            return self.shard.fed.has_import(mid)
+
+    def unresolved(self) -> list[dict]:
+        self._check()
+        out = self.shard.unresolved_migrations
+        self.shard.unresolved_migrations = []
+        return out
+
 
 class FederatedCluster:
     """N shards + one arbiter on a shared virtual clock.
@@ -190,7 +299,8 @@ class FederatedCluster:
 
     def __init__(self, shards: dict[str, dict[str, int]],
                  cpu: float = 16.0, mem_gb: int = 64,
-                 wal_dir: str | None = None, config_kw=None):
+                 wal_dir: str | None = None, config_kw=None,
+                 global_limits=None, publish_slack: int = 1):
         self.shards: dict[str, SimShard] = {}
         specs = []
         for name in sorted(shards):
@@ -198,7 +308,9 @@ class FederatedCluster:
                         if wal_dir is not None else None)
             self.shards[name] = SimShard(
                 name, shards[name], cpu=cpu, mem_gb=mem_gb,
-                wal_path=wal_path, config_kw=config_kw)
+                wal_path=wal_path, config_kw=config_kw,
+                global_limits=global_limits, n_shards=len(shards),
+                publish_slack=publish_slack)
             specs.append(ShardSpec(
                 name=name,
                 partitions=tuple(sorted(shards[name]))))
@@ -206,6 +318,8 @@ class FederatedCluster:
         self.handles = {name: ShardHandle(s)
                         for name, s in self.shards.items()}
         self.arbiter = PlacementArbiter(self.shard_map, self.handles)
+        self.coordinator = MigrationCoordinator(
+            self.shard_map, self.handles, self._install_map)
         self.now = 0.0
 
     # -- routing --
@@ -253,6 +367,48 @@ class FederatedCluster:
                 return self.now
         return self.now
 
+    # -- live partition migration / cluster-wide accounting --
+
+    def _install_map(self, new_map: ShardMap) -> None:
+        """The coordinator's flip hook: routing and the arbiter adopt
+        the successor map in one assignment each — every later lookup
+        (submit routing, gang planning) sees the new owner."""
+        self.shard_map = new_map
+        self.arbiter.shard_map = new_map
+
+    def migrate(self, partition: str, dest: str,
+                on_exported=None) -> dict:
+        """Drive one live partition migration at the current virtual
+        time (see MigrationCoordinator.migrate; ``on_exported`` is the
+        chaos seam where a source SIGKILL lands)."""
+        return self.coordinator.migrate(partition, dest, self.now,
+                                        on_exported=on_exported)
+
+    def resolve_migrations(self, source: str) -> list[dict]:
+        """Settle a restarted source's in-flight handoffs."""
+        return self.coordinator.resolve(source, self.now)
+
+    def pump_usage(self, now: float | None = None) -> int:
+        """One gossip round: every live shard publishes its UsageBook
+        summary and ingests everyone else's.  Returns the number of
+        documents exchanged.  Call cadence IS the staleness bound —
+        every tick approximates staleness 0, sparser pumping exercises
+        the conservative slack (fed/usage.py)."""
+        now = self.now if now is None else now
+        docs = []
+        for shard in self.shards.values():
+            book = shard.scheduler.global_usage
+            if shard.alive and book is not None:
+                with shard.lock:
+                    docs.append(book.publish(now))
+        for shard in self.shards.values():
+            book = shard.scheduler.global_usage
+            if shard.alive and book is not None:
+                with shard.lock:
+                    for doc in docs:
+                        book.ingest(doc, now)
+        return len(docs)
+
     # -- failure injection / audit --
 
     def kill(self, name: str) -> None:
@@ -282,6 +438,32 @@ class FederatedCluster:
                                else len(doc["doubled"]))
             out["checked"] += doc["checked"]
         return out
+
+    def ledger_by_name(self, names) -> dict:
+        """Exactly-once audit ACROSS shards, keyed by job NAME (ids are
+        shard-local and change when a job migrates): every submitted
+        name must reach exactly one terminal state federation-wide.
+        ``lost`` = names with no terminal anywhere, ``doubled`` = names
+        terminal on more than one job."""
+        ends: dict[str, int] = {}
+        live: dict[str, int] = {}
+        for shard in self.shards.values():
+            sched = shard.scheduler
+            for job in sched.history.values():
+                if job.status.is_terminal:
+                    ends[job.spec.name] = ends.get(job.spec.name, 0) + 1
+            for store in (sched.pending, sched.running):
+                for job in store.values():
+                    live[job.spec.name] = live.get(job.spec.name, 0) + 1
+        names = list(names)
+        return {
+            "checked": len(names),
+            "lost": [n for n in names
+                     if not ends.get(n) and not live.get(n)],
+            "doubled": [n for n in names
+                        if ends.get(n, 0) + live.get(n, 0) > 1],
+            "still_live": [n for n in names if live.get(n)],
+        }
 
     def stats(self) -> dict:
         return {

@@ -128,11 +128,19 @@ def multifactor_priority(
     running: RunningPriorityAttrs,
     weights: PriorityWeights,
     num_accounts: int,
+    extra_service: jax.Array | None = None,
 ) -> jax.Array:
     """Compute f32[J] priorities for the pending batch.
 
     Invalid (padding) rows get -inf so any downstream descending sort pushes
     them last.
+
+    ``extra_service`` (f32[num_accounts], optional) adds out-of-band
+    service units into the per-account service sum BEFORE the fair-share
+    normalization — the federation's cluster-wide fair-share input
+    (fed/usage.py): accounts burning capacity on other shards sink in
+    this shard's queue too.  None keeps the single-cluster behavior
+    bit-identical.
     """
     p_ok = pending.valid
     r_ok = running.valid
@@ -183,6 +191,9 @@ def multifactor_priority(
     acc_service = jax.ops.segment_sum(
         service_val, jnp.where(r_ok, running.account, num_accounts),
         num_segments=num_accounts + 1)[:num_accounts]
+    if extra_service is not None:
+        acc_service = acc_service + jnp.maximum(
+            extra_service.astype(jnp.float32), 0.0)
 
     # Accounts present = pending accounts ∪ running accounts.
     acc_present = jnp.zeros(num_accounts + 1, bool)
@@ -190,6 +201,11 @@ def multifactor_priority(
         jnp.where(p_ok, pending.account, num_accounts)].set(True)
     acc_present = acc_present.at[
         jnp.where(r_ok, running.account, num_accounts)].set(True)
+    if extra_service is not None:
+        # an account with remote service is present even with no local
+        # running jobs — its remote burn must widen the bounds
+        acc_present = acc_present.at[:num_accounts].set(
+            acc_present[:num_accounts] | (extra_service > 0))
     acc_present = acc_present[:num_accounts]
     sv_min = _masked_min(acc_service, acc_present)
     sv_max = _masked_max(acc_service, acc_present)

@@ -519,6 +519,128 @@ def replay_federation(scale: float, rng, wal_dir: str | None = None,
     )
 
 
+def replay_rebalance(scale: float, rng, wal_dir: str | None = None):
+    """Elastic-federation drill (REPLAY_r08): a two-shard submit storm
+    with global per-user limits gossiping under bounded staleness, then
+    a LIVE migration of the loaded partition mid-storm — with the
+    source shard SIGKILL'd at the worst moment of the handoff (begin
+    durable, payload exported, commit never acknowledged).  The source
+    recovers from its WAL, the coordinator resolves the bare begin
+    against the destination's adopted import, the storm finishes, and
+    the run audits itself BY NAME across shards (ids renumber on
+    import): every submitted job must reach exactly one terminal state
+    federation-wide — zero lost, zero doubled."""
+    import collections
+    import shutil
+    import tempfile
+
+    from cranesched_tpu.ctld.defs import JobSpec, ResourceSpec
+    from cranesched_tpu.fed.sim import FederatedCluster
+    from cranesched_tpu.fed.usage import GlobalLimits
+
+    n_per_part = max(int(100 * scale), 4)
+    n_jobs = max(int(2000 * scale), 80)
+    limit = max(n_jobs // 2, 20)
+    tmp = wal_dir or tempfile.mkdtemp(prefix="crane-rebalance-replay-")
+    fc = FederatedCluster(
+        {"east": {"batch": n_per_part,
+                  "debug": max(n_per_part // 2, 2)},
+         "west": {"gpu": n_per_part}},
+        cpu=16.0, mem_gb=64, wal_dir=tmp,
+        global_limits=GlobalLimits(max_submit_jobs_per_user=limit),
+        publish_slack=4)
+    parts = ("batch", "batch", "debug", "gpu")  # batch-heavy: the
+    events = []                                 # shard we will unload
+    for i in range(n_jobs):
+        events.append(JobSpec(
+            name=f"r{i:05d}", user="u",
+            partition=parts[int(rng.integers(0, 4))],
+            res=ResourceSpec(cpu=float(rng.integers(1, 5)),
+                             mem_bytes=int(rng.integers(1, 9)) << 30,
+                             memsw_bytes=int(rng.integers(1, 9)) << 30),
+            sim_runtime=float(rng.integers(5, 60))))
+
+    wave = max(n_jobs // 40, 1)
+    migrate_at = n_jobs // 2
+    backlog = collections.deque(events)
+    t0 = time.perf_counter()
+    submitted = admitted = denied = 0
+    names: list[str] = []
+    migration = None
+    resolved = None
+    while backlog:
+        for _ in range(min(wave, len(backlog))):
+            ev = backlog[0]
+            try:
+                _, jid = fc.submit(ev)
+            except RuntimeError:
+                break  # owning shard down mid-handoff — client retries
+            backlog.popleft()
+            submitted += 1
+            if jid:
+                admitted += 1
+                names.append(ev.name)
+            else:
+                denied += 1  # sealed partition or global limit gate
+        if migration is None and submitted >= migrate_at:
+            # the storm's hot shard hands off its loaded partition —
+            # and dies right after the export leaves (the WAL has the
+            # begin; the dest adopts; the commit can never be served)
+            migration = fc.migrate(
+                "batch", "west",
+                on_exported=lambda payload: fc.kill("east"))
+            assert migration["committed"] is False
+            fc.recover("east")
+            resolved = fc.resolve_migrations("east")
+        fc.tick()
+        fc.pump_usage(fc.now)
+    fc.run_until_drained()
+    wall = time.perf_counter() - t0
+
+    audit = fc.ledger_by_name(names)
+    in_book = sum(
+        c.submit_jobs
+        for s in fc.shards.values()
+        for c in [s.scheduler.global_usage._user.get("u")] if c)
+    ok = bool(
+        migration is not None
+        and [r["resolution"] for r in resolved] == ["commit"]
+        and audit["lost"] == [] and audit["doubled"] == []
+        and audit["still_live"] == []
+        and admitted <= n_jobs
+        and in_book == 0  # every slot released on terminal
+        and fc.shard_map.shard_for_partition("batch") == "west")
+    if wal_dir is None:
+        shutil.rmtree(tmp, ignore_errors=True)
+    finished = sum(len(s.scheduler.history)
+                   for s in fc.shards.values())
+    completed = sum(
+        1 for s in fc.shards.values()
+        for j in s.scheduler.history.values()
+        if j.status.value == "Completed")
+    return dict(
+        mode="rebalance",
+        shards={name: dict(s.partitions)
+                for name, s in fc.shards.items()},
+        jobs_submitted=submitted,
+        admitted=admitted,
+        denied_at_gate=denied,
+        global_submit_limit=limit,
+        migration=migration,
+        resolved=[r["resolution"] for r in (resolved or [])],
+        map_epoch=fc.shard_map.epoch,
+        jobs_finished=finished,
+        completed=completed,
+        cycles=int(fc.now),
+        virtual_drain_s=fc.now,
+        wall_s=round(wall, 3),
+        jobs_per_wall_s=round(finished / wall, 1) if wall else 0.0,
+        audit={k: (len(v) if isinstance(v, list) else v)
+               for k, v in audit.items()},
+        ok=ok,
+    )
+
+
 CONFIGS = {
     "fifo": replay_fifo,
     "minload": replay_minload,
@@ -551,9 +673,16 @@ def main(argv=None) -> int:
                          "gangs, one shard SIGKILL'd mid-storm; "
                          "asserts zero lost/doubled via the jobtrace "
                          "ledger")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="elastic-federation drill: live-migrate the "
+                         "loaded partition mid-storm with the source "
+                         "SIGKILL'd during the handoff, recover, "
+                         "resolve; asserts exactly-once by job name "
+                         "and the global submit limit")
     args = ap.parse_args(argv)
-    if args.config is None and not args.federation:
-        ap.error("a config is required unless --federation is given")
+    if args.config is None and not (args.federation or args.rebalance):
+        ap.error("a config is required unless --federation or "
+                 "--rebalance is given")
 
     run = _run_direct
     if args.slo:
@@ -573,6 +702,9 @@ def main(argv=None) -> int:
     if args.federation:
         rng = np.random.default_rng(args.seed)
         results["federation"] = replay_federation(args.scale, rng)
+    if args.rebalance:
+        rng = np.random.default_rng(args.seed)
+        results["rebalance"] = replay_rebalance(args.scale, rng)
     if args.json:
         print(json.dumps(results))
     else:

@@ -329,6 +329,24 @@ class CtldClient:
                                    fencing_epoch=fencing_epoch),
             pb.OkReply)
 
+    def fetch_usage(self) -> pb.FetchUsageReply:
+        """This shard's usage-gossip summary (cluster-wide accounting)."""
+        return self._call("FetchUsage", pb.FetchUsageRequest(),
+                          pb.FetchUsageReply)
+
+    def migrate_partition(self, partition: str, dest_shard: str,
+                          phase: str = "",
+                          payload: str = "") -> pb.MigratePartitionReply:
+        """Live partition migration: ``phase=""`` drives the whole
+        handoff (dial the source shard), ``phase="import"`` ships an
+        exported payload to the destination (shard-to-shard)."""
+        return self._call(
+            "MigratePartition",
+            pb.MigratePartitionRequest(partition=partition,
+                                       dest_shard=dest_shard,
+                                       phase=phase, payload=payload),
+            pb.MigratePartitionReply)
+
 
 # gRPC codes that mean "try the next ctld": the endpoint is down/
 # unreachable, or it answered but refused as a standby
@@ -368,6 +386,10 @@ class HaCtldClient(CtldClient):
         # rotation list, so their clients live in their own cache
         self._shard_routes: dict[str, str] = {}
         self._route_clients: dict[str, CtldClient] = {}
+        # the shard-map epoch the routes were learned at: a reply
+        # stamped with a NEWER epoch means a live partition migration
+        # flipped the map — re-learn instead of redirect-bouncing
+        self._map_epoch = 0
 
     def _at(self, idx: int) -> CtldClient:
         cli = self._clients.get(idx)
@@ -400,6 +422,8 @@ class HaCtldClient(CtldClient):
         except grpc.RpcError:
             return 0
         n = 0
+        self._shard_routes.clear()
+        self._map_epoch = reply.map_epoch
         for shard in reply.shards:
             if not shard.address:
                 continue
@@ -426,10 +450,16 @@ class HaCtldClient(CtldClient):
         addr = self._shard_routes.get(spec.partition)
         if addr:
             try:
-                return self._route(addr).submit(
+                reply = self._route(addr).submit(
                     spec, forwarded=forwarded,
                     forwarded_at=forwarded_at,
                     forwarded_from=forwarded_from)
+                if reply.map_epoch > self._map_epoch:
+                    self.learn_shard_map()
+                if reply.redirect_address:
+                    self._shard_routes[spec.partition] = \
+                        reply.redirect_address
+                return reply
             except grpc.RpcError as e:
                 if e.code() not in _ROTATE_CODES:
                     raise
@@ -446,6 +476,11 @@ class HaCtldClient(CtldClient):
                                forwarded_from=forwarded_from)
         if reply.redirect_address:
             self._shard_routes[spec.partition] = reply.redirect_address
+        if reply.map_epoch > self._map_epoch:
+            # a migration flipped the map since we learned it: refresh
+            # every route in one query rather than paying a redirect
+            # bounce per moved partition
+            self.learn_shard_map()
         return reply
 
     def _call(self, name, request, reply_cls):

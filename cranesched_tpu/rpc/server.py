@@ -95,6 +95,7 @@ class CtldServer:
         self._lock = threading.Lock()
         self._server: grpc.Server | None = None
         self._cycle_thread: threading.Thread | None = None
+        self._usage_thread: threading.Thread | None = None
         self._stop = threading.Event()
         # event-driven cycle wakeup (the reference's
         # m_task_scheduler_thread_ condition variable): submits, status
@@ -212,6 +213,21 @@ class CtldServer:
                     f"spec claims {spec.user})")
         return ""
 
+    def _trusted_forward(self, request) -> bool:
+        """True for a forwarded submit arriving from a known peer shard
+        of this federation.  The identity check already ran at the
+        ingress shard — the shard that forwarded it — and the
+        shard-to-shard hop carries no user credential, so re-running it
+        here would deny every forwarded submit under auth (and
+        double-count the denial metrics without it).  Trust is scoped:
+        a request claiming ``forwarded`` outside a federation, or
+        naming an unknown shard, still gets the full check."""
+        if self.shard_map is None or not request.forwarded:
+            return False
+        peer = request.forwarded_from
+        return bool(peer) and peer != self.shard_name \
+            and self.shard_map.spec(peer) is not None
+
     def _fed_owner(self, partition: str):
         """(owner shard, leader address) when ``partition`` belongs to
         a DIFFERENT shard of the federation, else None — local
@@ -224,6 +240,13 @@ class CtldServer:
             return None
         spec = self.shard_map.spec(owner)
         return owner, (spec.address if spec is not None else "")
+
+    def _map_epoch(self) -> int:
+        """The shard-map epoch this server currently routes by; stamped
+        on submit/shard-map replies so clients detect a live partition
+        migration and re-learn routes instead of redirect-bouncing on a
+        stale map."""
+        return self.shard_map.epoch if self.shard_map is not None else 0
 
     def _fed_client(self, address: str):
         cli = self._fwd_clients.get(address)
@@ -245,6 +268,7 @@ class CtldServer:
             return pb.SubmitJobReply(
                 job_id=0, shard=self.shard_name,
                 redirect_address=address,
+                map_epoch=self._map_epoch(),
                 error=f"partition {partition!r} belongs to shard "
                       f"{owner!r}")
         try:
@@ -265,6 +289,7 @@ class CtldServer:
             return pb.SubmitJobReply(
                 job_id=0, shard=self.shard_name,
                 redirect_address=address,
+                map_epoch=self._map_epoch(),
                 error=f"forward to shard {owner!r} failed: "
                       f"{exc.code().name}")
         self.scheduler.events.emit(
@@ -273,16 +298,21 @@ class CtldServer:
             detail=f"partition={partition} -> shard={owner}")
         _MET_FWD.inc()
         return pb.SubmitJobReply(job_id=reply.job_id, error=reply.error,
-                                 shard=owner, redirect_address=address)
+                                 shard=owner, redirect_address=address,
+                                 map_epoch=self._map_epoch())
 
     def SubmitBatchJob(self, request, context):
         try:
             spec = spec_from_pb(request.spec)
         except ValueError as exc:
             return pb.SubmitJobReply(job_id=0, error=str(exc))
-        deny = self._check_submit_identity(self._ident(context), spec)
-        if deny:
-            return pb.SubmitJobReply(job_id=0, error=deny)
+        # the identity check runs exactly once, at the INGRESS shard: a
+        # trusted forward was already checked where the client connected
+        if not self._trusted_forward(request):
+            deny = self._check_submit_identity(self._ident(context),
+                                               spec)
+            if deny:
+                return pb.SubmitJobReply(job_id=0, error=deny)
         owner = self._fed_owner(spec.partition)
         if owner is not None:
             return self._forward_submit(request.spec, spec.partition,
@@ -302,7 +332,7 @@ class CtldServer:
                     skew=round(now - t_fwd, 6))
         return pb.SubmitJobReply(
             job_id=job_id, error="" if job_id else "rejected",
-            shard=self.shard_name)
+            shard=self.shard_name, map_epoch=self._map_epoch())
 
     def SubmitBatchJobs(self, request, context):
         now = self._now()
@@ -753,11 +783,15 @@ class CtldServer:
             if self.shard_name or self.shard_map is not None:
                 doc["fed"] = {
                     "shard": self.shard_name,
+                    "map_epoch": self._map_epoch(),
                     "shards": (self.shard_map.doc()
                                if self.shard_map is not None else []),
                 }
                 if self.scheduler.fed is not None:
                     doc["fed"].update(self.scheduler.fed.stats())
+                if self.scheduler.global_usage is not None:
+                    doc["fed"]["usage"] = \
+                        self.scheduler.global_usage.stats()
             return pb.StatsReply(json=_json.dumps(doc),
                                  durable_seq=self._durable_seq(),
                                  shard=self.shard_name)
@@ -1112,7 +1146,8 @@ class CtldServer:
         if self.shard_map is None:
             return pb.QueryShardMapReply(shard=self.shard_name,
                                          error="not federated")
-        reply = pb.QueryShardMapReply(shard=self.shard_name)
+        reply = pb.QueryShardMapReply(shard=self.shard_name,
+                                      map_epoch=self.shard_map.epoch)
         for doc in self.shard_map.doc():
             reply.shards.add(name=doc["name"],
                              partitions=doc["partitions"],
@@ -1178,6 +1213,123 @@ class CtldServer:
         with self._lock:
             ok = fed.release_lease(request.lease_id, self._now())
         return pb.OkReply(ok=ok, error="" if ok else "no such lease")
+
+    # ---- elastic federation: usage gossip + live migration ----
+
+    def FetchUsage(self, request, context):
+        """This shard's per-user/per-account usage summary, stamped
+        with its WAL watermark (``durable_seq``).  Peers poll this and
+        feed the payload to their own UsageBook.ingest — the gossip
+        transport for cluster-wide MaxJobs / fair-share."""
+        import json as _json
+        self._require_authenticated(self._ident(context), context)
+        book = self.scheduler.global_usage
+        if book is None:
+            return pb.FetchUsageReply(ok=False, shard=self.shard_name,
+                                      error="no global accounting")
+        with self._lock:
+            doc = book.publish(self._now())
+            seq = self._durable_seq()
+        return pb.FetchUsageReply(ok=True, shard=self.shard_name,
+                                  payload=_json.dumps(doc),
+                                  durable_seq=seq)
+
+    def MigratePartition(self, request, context):
+        """Live partition migration (admin-only).  Two phases share the
+        verb:
+
+        * ``phase=""`` — drive the whole handoff.  Must land on the
+          partition's source shard (``cfed migrate`` dials it from the
+          map); runs seal -> export locally, ships the payload to the
+          dest with ``phase="import"``, flips this shard's map, then
+          commits.  An import failure aborts durably and re-opens the
+          partition in place.
+        * ``phase="import"`` — adopt an exported payload: one WAL group
+          creates every job under fresh local ids, then this shard's
+          map flips so it starts routing the partition to itself.
+        """
+        import json as _json
+        deny = self._deny_admin(self._ident(context))
+        if deny:
+            return pb.MigratePartitionReply(ok=False, error=deny)
+        fed = self.scheduler.fed
+        if fed is None or self.shard_map is None:
+            return pb.MigratePartitionReply(
+                ok=False, error="not a federation shard")
+        now = self._now()
+        if request.phase == "import":
+            try:
+                payload = _json.loads(request.payload)
+            except _json.JSONDecodeError as exc:
+                return pb.MigratePartitionReply(
+                    ok=False, error=f"bad payload: {exc}")
+            with self._lock:
+                try:
+                    imported, _nodes = fed.import_partition(payload, now)
+                except ValueError as exc:
+                    return pb.MigratePartitionReply(ok=False,
+                                                    error=str(exc))
+                try:
+                    self.shard_map = self.shard_map.with_partition_moved(
+                        payload["partition"], self.shard_name)
+                except ValueError:
+                    pass  # already ours (idempotent re-import)
+            self._cycle_kick.set()
+            return pb.MigratePartitionReply(
+                ok=True, mid=payload.get("mid", ""),
+                jobs_moved=len(imported), map_epoch=self._map_epoch())
+        if request.phase:
+            return pb.MigratePartitionReply(
+                ok=False, error=f"unknown phase {request.phase!r}")
+        partition, dest = request.partition, request.dest_shard
+        owner = self.shard_map.shard_for_partition(partition)
+        if owner != self.shard_name:
+            spec = self.shard_map.spec(owner) if owner else None
+            return pb.MigratePartitionReply(
+                ok=False,
+                error=f"partition {partition!r} belongs to shard "
+                      f"{owner!r}"
+                      + (f" at {spec.address}" if spec is not None
+                         and spec.address else ""))
+        dspec = self.shard_map.spec(dest)
+        if dspec is None or dest == self.shard_name:
+            return pb.MigratePartitionReply(
+                ok=False, error=f"bad destination shard {dest!r}")
+        mid = (f"mig:{partition}:{self.shard_map.epoch}"
+               f":{self.shard_name}->{dest}")
+        with self._lock:
+            try:
+                fed.seal_partition(mid, partition, dest, now)
+                payload = fed.export_partition(mid, partition)
+            except ValueError as exc:
+                return pb.MigratePartitionReply(ok=False, error=str(exc))
+        try:
+            dreply = self._fed_client(dspec.address).migrate_partition(
+                partition, dest, phase="import",
+                payload=_json.dumps(payload))
+            if not dreply.ok:
+                raise RuntimeError(dreply.error)
+        except Exception as exc:
+            with self._lock:
+                fed.abort_migration(mid, partition, now)
+            return pb.MigratePartitionReply(
+                ok=False, mid=mid,
+                error=f"dest import failed (aborted): {exc}")
+        # the dest holds the jobs durably: flip BEFORE commit, so a
+        # crash here still routes the partition to the shard that has
+        # the jobs; recovery resolves the bare begin against the dest
+        with self._lock:
+            self.shard_map = self.shard_map.with_partition_moved(
+                partition, dest)
+            fed.commit_migration(mid, partition, now)
+        self.scheduler.events.emit(
+            "fed_migrate", "info", time=now,
+            detail=f"partition={partition} -> shard={dest} "
+                   f"jobs={dreply.jobs_moved} "
+                   f"epoch={self.shard_map.epoch}")
+        return pb.MigratePartitionReply(
+            ok=True, mid=mid, jobs_moved=dreply.jobs_moved,
+            map_epoch=self.shard_map.epoch)
 
     def CaptureProfile(self, request, context):
         """Arm an on-demand jax.profiler window spanning the next N
@@ -1318,6 +1470,9 @@ class CtldServer:
         "LeaseNodes": (pb.LeaseNodesRequest, pb.LeaseNodesReply),
         "ConfirmGang": (pb.ConfirmGangRequest, pb.ConfirmGangReply),
         "ReleaseLease": (pb.ReleaseLeaseRequest, pb.OkReply),
+        "FetchUsage": (pb.FetchUsageRequest, pb.FetchUsageReply),
+        "MigratePartition": (pb.MigratePartitionRequest,
+                             pb.MigratePartitionReply),
     }
 
     # the surface a standby may serve from its shadow state; everything
@@ -1386,7 +1541,43 @@ class CtldServer:
             self._cycle_thread = threading.Thread(
                 target=self._cycle_loop, daemon=True)
             self._cycle_thread.start()
+        if (self.shard_map is not None
+                and self.scheduler.global_usage is not None):
+            self._usage_thread = threading.Thread(
+                target=self._usage_gossip_loop, daemon=True)
+            self._usage_thread.start()
         return port
+
+    def _usage_gossip_loop(self) -> None:
+        """Cluster-wide accounting pump (fed/usage.py): publish the
+        local UsageBook on a fixed cadence — the publish IS the
+        throttle release, a shard may run at most ``publish_slack``
+        admissions ahead of its last summary — and pull every peer's
+        latest via FetchUsage, ingesting under the lock.  A peer
+        outage only ages that peer's summary (the conservative
+        admission gate is built for exactly that); it never blocks
+        this loop or the cycle thread."""
+        import json as _json
+        interval = max(self.cycle_interval, 0.5)
+        while not self._stop.wait(interval):
+            if self.ha_role != "leader":
+                continue
+            book = self.scheduler.global_usage
+            with self._lock:
+                book.publish(self._now())
+            for name, spec in self.shard_map.shards.items():
+                if name == self.shard_name or not spec.address:
+                    continue
+                try:
+                    reply = self._fed_client(
+                        spec.address).fetch_usage()
+                    doc = _json.loads(reply.payload) if reply.ok \
+                        else None
+                except Exception:
+                    continue
+                if doc:
+                    with self._lock:
+                        book.ingest(doc, self._now())
 
     def _cycle_loop(self) -> None:
         """The 1 Hz ScheduleThread_ analog (JobScheduler.cpp:1321,1981).

@@ -164,7 +164,21 @@ class CraneConfig:
         if not self.federation:
             return None
         from cranesched_tpu.fed.shardmap import ShardMap
-        return ShardMap.from_config(self.federation)
+        # validate against the cluster's partition inventory: a
+        # configured partition no shard owns routes submits nowhere
+        return ShardMap.from_config(
+            self.federation,
+            configured_partitions=[p.name for p in self.partitions])
+
+    def global_limits(self):
+        """-> fed.usage.GlobalLimits from ``Federation: Limits:``, or
+        None when the section is absent (per-shard limits only)."""
+        section = self.federation.get("Limits") if self.federation \
+            else None
+        if not section:
+            return None
+        from cranesched_tpu.fed.usage import GlobalLimits
+        return GlobalLimits.from_config(section)
 
     @property
     def shard_name(self) -> str:

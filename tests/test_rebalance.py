@@ -1,0 +1,552 @@
+"""Elastic federation: live partition migration + cluster-wide
+accounting (fed/rebalance.py, fed/usage.py).
+
+The drills assert the two invariants the subsystem exists for:
+
+* a migration — including a source SIGKILL mid-handoff — never loses a
+  job and never runs one twice (exactly-once, audited by NAME across
+  shards because ids renumber on import);
+* the global MaxJobs/MaxSubmitJobs limits hold cluster-wide: bit-exact
+  against a single-controller oracle at staleness 0, and NEVER overshot
+  under bounded-staleness gossip.
+
+All tests run in the ``make tier1-rebalance`` lane (``-m rebalance``).
+"""
+
+import socket
+
+import pytest
+
+from cranesched_tpu.ctld import (
+    JobScheduler,
+    JobSpec,
+    MetaContainer,
+    ResourceSpec,
+    SchedulerConfig,
+)
+from cranesched_tpu.ctld.wal import WriteAheadLog
+from cranesched_tpu.fed.rebalance import DetectorConfig, HotShardDetector
+from cranesched_tpu.fed.shard import FedShardPlane
+from cranesched_tpu.fed.shardmap import ShardMap, ShardSpec
+from cranesched_tpu.fed.sim import FederatedCluster, SimShard
+from cranesched_tpu.fed.usage import GlobalLimits, UsageBook
+from cranesched_tpu.rpc import crane_pb2 as pb, serve
+from cranesched_tpu.rpc.client import CtldClient
+
+pytestmark = pytest.mark.rebalance
+
+
+def _spec(i, partition="batch", user="u", runtime=5.0, cpu=2.0):
+    return JobSpec(name=f"mig{i:03d}", user=user, partition=partition,
+                   res=ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                   sim_runtime=runtime)
+
+
+# ---------------------------------------------------------------------------
+# hot-shard detector
+# ---------------------------------------------------------------------------
+
+def test_detector_cold_start_and_single_shard_decide_none():
+    det = HotShardDetector()
+    # cold start: no samples at all
+    assert det.decide(0.0, ["east", "west"]) is None
+    # a single-shard federation has nowhere to move load
+    for t in range(10):
+        det.observe("east", float(t), submit_p99_ms=1e9)
+    assert det.decide(10.0, ["east"]) is None
+    # ...but the same samples with a peer available do decide
+    assert det.decide(10.0, ["east", "west"]) == "east"
+
+
+def test_detector_needs_sustained_signal_and_any_signal_latches():
+    det = HotShardDetector(DetectorConfig(sustain=3))
+    # two hot samples then a genuinely cool one: streak resets
+    det.observe("east", 0.0, submit_p99_ms=100.0)
+    det.observe("east", 1.0, submit_p99_ms=100.0)
+    det.observe("east", 2.0, submit_p99_ms=0.0)
+    assert det.decide(2.0, ["east", "west"]) is None
+    # three consecutive — via a DIFFERENT signal (lock share) — latch
+    for t in (3.0, 4.0, 5.0):
+        det.observe("east", t, lock_held_share=0.9)
+    assert det.decide(5.0, ["east", "west"]) == "east"
+
+
+def test_detector_flapping_in_dead_zone_never_storms():
+    """A signal oscillating between hot and the hysteresis dead zone
+    (above cool, below hot) latches at most once and, once latched,
+    stays latched — it cannot latch/unlatch/latch into a migration
+    storm."""
+    cfg = DetectorConfig(submit_p99_hot_ms=50.0, cool_ratio=0.6,
+                         sustain=3, cooldown_s=100.0)
+    det = HotShardDetector(cfg)
+    # flap: hot, dead-zone (40ms: < 50 hot, > 30 cool), hot, dead-zone…
+    # the dead-zone samples reset the streak, so the latch never forms
+    for t in range(20):
+        val = 100.0 if t % 2 == 0 else 40.0
+        det.observe("east", float(t), submit_p99_ms=val)
+    assert det.decide(20.0, ["east", "west"]) is None
+    # sustained hot latches; subsequent dead-zone dips do NOT unlatch
+    for t in range(21, 24):
+        det.observe("east", float(t), submit_p99_ms=100.0)
+    det.observe("east", 24.0, submit_p99_ms=40.0)
+    assert det.decide(24.0, ["east", "west"]) == "east"
+    # only a genuinely cool sample unlatches
+    det.observe("east", 25.0, submit_p99_ms=5.0)
+    assert det.decide(25.0, ["east", "west"]) is None
+
+
+def test_detector_cooldown_after_migration():
+    cfg = DetectorConfig(sustain=1, cooldown_s=300.0)
+    det = HotShardDetector(cfg)
+    det.observe("east", 0.0, slo_burn=5.0)
+    assert det.decide(0.0, ["east", "west"]) == "east"
+    det.migrated(0.0)
+    # even a re-latched shard cannot migrate inside the cooldown
+    det.observe("east", 1.0, slo_burn=5.0)
+    assert det.decide(1.0, ["east", "west"]) is None
+    det.observe("east", 301.0, slo_burn=5.0)
+    assert det.decide(301.0, ["east", "west"]) == "east"
+
+
+# ---------------------------------------------------------------------------
+# shard map versioning
+# ---------------------------------------------------------------------------
+
+def test_with_partition_moved_bumps_epoch_and_validates():
+    m = ShardMap([ShardSpec("east", ("batch", "debug")),
+                  ShardSpec("west", ("gpu",))])
+    m2 = m.with_partition_moved("batch", "west")
+    assert m2.epoch == m.epoch + 1
+    assert m2.shard_for_partition("batch") == "west"
+    assert m2.shard_for_partition("debug") == "east"
+    # the predecessor map is untouched (immutably versioned)
+    assert m.shard_for_partition("batch") == "east"
+    with pytest.raises(ValueError, match="nope"):
+        m.with_partition_moved("nope", "west")
+    with pytest.raises(ValueError, match="already"):
+        m.with_partition_moved("gpu", "west")
+    with pytest.raises(ValueError, match="unknown"):
+        m.with_partition_moved("batch", "south")
+
+
+def test_configured_partition_owned_by_no_shard_is_an_error():
+    """Satellite: a federation that silently drops a configured
+    partition routes its submits nowhere — the map must refuse, naming
+    the partition."""
+    with pytest.raises(ValueError, match="orphan"):
+        ShardMap([ShardSpec("east", ("batch",))],
+                 configured_partitions=("batch", "orphan"))
+    with pytest.raises(ValueError, match="orphan"):
+        ShardMap.from_config(
+            {"Shards": [{"name": "east", "partitions": ["batch"]}]},
+            configured_partitions=("batch", "orphan"))
+
+
+# ---------------------------------------------------------------------------
+# live migration drills (sim federation)
+# ---------------------------------------------------------------------------
+
+def _storm(fc, n=24, runtime=6.0):
+    """Submit a mixed storm across both shards; returns all names."""
+    names = []
+    for i in range(n):
+        part = "gpu" if i % 3 == 0 else "batch"
+        spec = _spec(i, partition=part, runtime=runtime + (i % 4))
+        fc.submit(spec, 0.0)
+        names.append(spec.name)
+    return names
+
+
+def test_live_migration_mid_storm_exactly_once(tmp_path):
+    """Migrate a partition while jobs are pending AND running on it:
+    every job reaches exactly one terminal state federation-wide."""
+    fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 3}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc)
+    for _ in range(3):          # let some batch jobs start running
+        fc.tick()
+    east = fc.shards["east"].scheduler
+    assert east.running, "drill needs in-flight jobs to hand off"
+    moved = len(east.pending) + len(east.running)
+
+    res = fc.migrate("batch", "west")
+    assert res["committed"] and res["jobs_imported"] == moved
+    assert fc.shard_map.epoch == 1
+    assert fc.shard_map.shard_for_partition("batch") == "west"
+    # the source forgot the jobs without stamping terminals...
+    assert not east.pending and not east.running
+    # ...and post-flip submits route to the new owner
+    shard, jid = fc.submit(_spec(900, partition="batch"), fc.now)
+    assert shard == "west" and jid > 0
+
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names + ["mig900"])
+    assert audit["lost"] == []
+    assert audit["doubled"] == []
+    assert audit["still_live"] == []
+
+
+def test_source_sigkill_mid_handoff_resolves_to_commit(tmp_path):
+    """The acceptance drill: SIGKILL the source right after export.
+    The dest has adopted; recovery surfaces the bare begin; resolve()
+    commits it.  Zero lost, zero doubled."""
+    fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 3}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc)
+    for _ in range(3):
+        fc.tick()
+
+    def boom(payload):
+        # the kill lands AFTER the begin record fsync'd and AFTER the
+        # export left — the worst window: dest will adopt, source
+        # cannot acknowledge the commit
+        fc.kill("east")
+
+    res = fc.migrate("batch", "west", on_exported=boom)
+    assert res["committed"] is False       # commit hit a dead shard
+    assert res["jobs_imported"] > 0        # but the dest adopted
+    assert fc.shard_map.shard_for_partition("batch") == "west"
+
+    fc.recover("east")
+    # recovery surfaced the bare fed_migrate_begin; the coordinator
+    # settles it against the dest (which has the import) -> commit
+    settled = fc.resolve_migrations("east")
+    assert [r["resolution"] for r in settled] == ["commit"]
+    east = fc.shards["east"].scheduler
+    assert not east.pending and not east.running
+
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names)
+    assert audit["lost"] == []
+    assert audit["doubled"] == []
+    assert audit["still_live"] == []
+
+
+def test_dest_dead_at_import_aborts_and_reopens(tmp_path):
+    """If the destination never adopts, the migration aborts durably
+    and the partition re-opens in place — jobs drain on the source."""
+    fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 3}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc, n=12)
+    for _ in range(2):
+        fc.tick()
+
+    def kill_dest(payload):
+        fc.kill("west")
+
+    with pytest.raises(RuntimeError):
+        fc.migrate("batch", "west", on_exported=kill_dest)
+    # no flip happened; the seal was annulled
+    assert fc.shard_map.epoch == 0
+    assert fc.shard_map.shard_for_partition("batch") == "east"
+    assert "batch" not in fc.shards["east"].scheduler.sealed_partitions
+    fc.recover("west")
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names)
+    assert audit["lost"] == [] and audit["doubled"] == []
+    assert audit["still_live"] == []
+
+
+def test_sealed_partition_refuses_new_submits(tmp_path):
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          wal_dir=str(tmp_path))
+    fc.submit(_spec(0), 0.0)
+    east = fc.shards["east"]
+    east.fed.seal_partition("mig:t", "batch", "west", 0.0)
+    assert east.scheduler.submit(_spec(1), 0.0) == 0
+    east.fed.abort_migration("mig:t", "batch", 0.0)
+    assert east.scheduler.submit(_spec(2), 0.0) > 0
+
+
+def test_replayed_source_filters_committed_jobs(tmp_path):
+    """A committed migration's job records must not resurrect on
+    source replay: the commit record is the filter, and it survives
+    WAL compaction forever."""
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc, n=8)
+    fc.tick()
+    fc.migrate("batch", "west")
+    # crash AFTER the commit: replay must not re-create the handed-off
+    # jobs from their (non-terminal) job records
+    fc.kill("east")
+    fc.recover("east")
+    east = fc.shards["east"].scheduler
+    assert not east.pending and not east.running
+    assert "batch" in fc.shards["east"].fed.migrated_away
+    migs = WriteAheadLog.replay_migrations(str(tmp_path / "east.wal"))
+    assert any(e["ev"] == "fed_migrate_commit" for e in migs.values())
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names)
+    assert audit["lost"] == [] and audit["doubled"] == []
+
+
+# ---------------------------------------------------------------------------
+# cluster-wide accounting
+# ---------------------------------------------------------------------------
+
+def _submit_round_robin(fc, n, user="u", pump_each=False):
+    """Try n submits alternating shards; returns the admitted count."""
+    admitted = 0
+    parts = ["batch", "gpu"]
+    for i in range(n):
+        spec = _spec(i, partition=parts[i % 2], user=user, runtime=50.0)
+        _, jid = fc.submit(spec, 0.0)
+        if jid:
+            admitted += 1
+        if pump_each:
+            fc.pump_usage(0.0)
+    return admitted
+
+
+def test_global_submit_limit_bit_exact_vs_oracle_at_staleness_zero():
+    """With gossip after every admission (staleness 0), two shards
+    admit EXACTLY what one controller holding the same limit would:
+    the limit, no more, no fewer."""
+    limits = GlobalLimits(max_submit_jobs_per_user=5)
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          global_limits=limits, publish_slack=0)
+    admitted = _submit_round_robin(fc, 12, pump_each=True)
+    assert admitted == 5  # bit-exact: the oracle admits exactly 5
+
+    # single-controller oracle over the union, same limit (no peers,
+    # so no publish throttle)
+    oracle = SimShard("solo", {"batch": 2, "gpu": 2},
+                      global_limits=limits, publish_slack=0)
+    solo = sum(1 if oracle.submit(
+        _spec(i, partition=("batch", "gpu")[i % 2], runtime=50.0),
+        0.0) else 0 for i in range(12))
+    assert solo == admitted == 5
+
+
+def test_global_limit_never_overshoots_under_bounded_staleness():
+    """No gossip at all (unbounded staleness): the publish throttle +
+    conservative slack must keep the federation-wide total AT OR UNDER
+    the limit — overshoot is the one forbidden outcome."""
+    limits = GlobalLimits(max_submit_jobs_per_user=6)
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          global_limits=limits, publish_slack=1)
+    admitted = _submit_round_robin(fc, 20, pump_each=False)
+    assert 0 < admitted <= 6
+    # a gossip round unlocks further conservative admissions, still
+    # bounded by the limit
+    fc.pump_usage(0.0)
+    admitted += _submit_round_robin(fc, 20, pump_each=False)
+    total = sum(len(s.scheduler.pending) + len(s.scheduler.running)
+                for s in fc.shards.values())
+    assert total <= 6
+
+
+def test_global_max_jobs_gates_running_not_just_submits():
+    """MaxJobsPerUser bounds RUNNING jobs cluster-wide: submits pass,
+    but the scheduler refuses to start more than the global cap."""
+    limits = GlobalLimits(max_jobs_per_user=2)
+    fc = FederatedCluster({"east": {"batch": 4}, "west": {"gpu": 4}},
+                          global_limits=limits, publish_slack=1)
+    for i in range(8):
+        fc.submit(_spec(i, partition=("batch", "gpu")[i % 2],
+                        runtime=100.0, cpu=1.0), 0.0)
+        fc.pump_usage(0.0)
+    for _ in range(5):
+        fc.tick()
+        fc.pump_usage(fc.now)
+    running = sum(len(s.scheduler.running) for s in fc.shards.values())
+    # with publish_slack=1 the conservative gate reserves one slot of
+    # slack per peer, so the two shards can never jointly exceed the cap
+    assert 0 < running <= 2
+
+
+def test_usage_book_stale_summary_never_rolls_backwards():
+    book = UsageBook("east", GlobalLimits(max_submit_jobs_per_user=10),
+                     n_shards=2)
+    new = {"shard": "west", "durable_seq": 9, "time": 2.0,
+           "user": {"u": {"jobs": 0, "submit_jobs": 4}}}
+    old = {"shard": "west", "durable_seq": 3, "time": 1.0,
+           "user": {"u": {"jobs": 0, "submit_jobs": 1}}}
+    book.ingest(new, 2.0)
+    book.ingest(old, 3.0)  # re-delivered older summary: ignored
+    assert book._remote["west"]["durable_seq"] == 9
+    book.forget("west")
+    assert book.staleness(10.0) == 0.0
+
+
+def test_migrated_jobs_keep_their_global_slots(tmp_path):
+    """A migration must not leak or double global submit slots: the
+    dest takes one per imported job, the source releases its copies."""
+    limits = GlobalLimits(max_submit_jobs_per_user=8)
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          wal_dir=str(tmp_path), global_limits=limits,
+                          publish_slack=0)
+    for i in range(4):
+        fc.submit(_spec(i, runtime=50.0), 0.0)
+        fc.pump_usage(0.0)
+    fc.migrate("batch", "west")
+    fc.pump_usage(0.0)
+    east = fc.shards["east"].scheduler.global_usage
+    west = fc.shards["west"].scheduler.global_usage
+    assert east._user.get("u") is None or \
+        east._user["u"].submit_jobs == 0
+    assert west._user["u"].submit_jobs == 4
+    # the federation-wide count is intact: 4 more fit, the 9th refuses
+    admitted = _submit_round_robin(fc, 8, pump_each=True)
+    assert admitted == 4
+
+
+# ---------------------------------------------------------------------------
+# RPC surface
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _shard_sched(name, partitions, nodes_per=2):
+    meta = MetaContainer()
+    nid = 0
+    for part in partitions:
+        for i in range(nodes_per):
+            meta.add_node(f"{name}-{part}-n{i}",
+                          meta.layout.encode(cpu=8.0,
+                                             mem_bytes=16 << 30,
+                                             memsw_bytes=16 << 30,
+                                             is_capacity=True),
+                          partitions=(part,))
+            meta.craned_up(nid)
+            nid += 1
+    return JobScheduler(meta, SchedulerConfig(backfill=False))
+
+
+def _fed_pair(auth_by_name=None, limits=None):
+    ports = {"east": _free_port(), "west": _free_port()}
+    shard_map = ShardMap([
+        ShardSpec("east", ("batch", "debug"),
+                  address=f"127.0.0.1:{ports['east']}"),
+        ShardSpec("west", ("gpu",),
+                  address=f"127.0.0.1:{ports['west']}"),
+    ])
+    servers = {}
+    for name in ("east", "west"):
+        sched = _shard_sched(name, shard_map.spec(name).partitions)
+        FedShardPlane(sched, name)
+        if limits is not None:
+            sched.global_usage = UsageBook(name, limits, n_shards=2)
+        server, bound = serve(
+            sched, tick_mode=True, address=f"127.0.0.1:{ports[name]}",
+            shard_name=name, shard_map=shard_map,
+            auth=(auth_by_name or {}).get(name))
+        assert bound == ports[name]
+        servers[name] = server
+    return shard_map, ports, servers
+
+
+def _pb_spec(user="u", partition="batch", cpu=1.0):
+    return pb.JobSpec(user=user, partition=partition,
+                      res=pb.ResourceSpec(cpu=cpu, mem_bytes=1 << 30,
+                                          memsw_bytes=1 << 30),
+                      sim_runtime=30.0)
+
+
+def test_forwarded_submit_identity_checked_exactly_once(tmp_path):
+    """Satellite regression: under auth, a misrouted submit used to be
+    identity-checked TWICE — at ingress (with the user's token) and at
+    the owning shard (over the credential-less shard hop, where it
+    always failed).  The owning shard must trust a forward from a known
+    peer; everything else still gets the full check."""
+    from cranesched_tpu.ctld.auth import AuthManager
+    auths = {n: AuthManager(str(tmp_path / f"{n}.tokens.json"))
+             for n in ("east", "west")}
+    shard_map, ports, servers = _fed_pair(auth_by_name=auths)
+    clients = []
+    try:
+        root = CtldClient(f"127.0.0.1:{ports['east']}",
+                          token=auths["east"].root_token)
+        clients.append(root)
+        token = root.issue_token("u").token
+        user_east = CtldClient(f"127.0.0.1:{ports['east']}",
+                               token=token)
+        clients.append(user_east)
+        # misrouted: "gpu" belongs to west; the forward hop carries no
+        # user credential, so this only passes if west trusts it
+        reply = user_east.submit(_pb_spec(partition="gpu"))
+        assert reply.error == "" and reply.job_id > 0
+        assert reply.shard == "west"
+        assert servers["west"].scheduler.pending
+        # a request CLAIMING forwarded from an unknown peer is still
+        # fully checked (fail-closed): no token -> denied
+        anon = CtldClient(f"127.0.0.1:{ports['west']}")
+        clients.append(anon)
+        fake = anon.submit(_pb_spec(partition="gpu"), forwarded=True,
+                           forwarded_from="mars")
+        assert "authentication required" in fake.error
+    finally:
+        for c in clients:
+            c.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_map_epoch_stamped_on_replies_and_fetch_usage():
+    limits = GlobalLimits(max_submit_jobs_per_user=100)
+    shard_map, ports, servers = _fed_pair(limits=limits)
+    cli = None
+    try:
+        cli = CtldClient(f"127.0.0.1:{ports['east']}")
+        m = cli.query_shard_map()
+        assert m.map_epoch == 0
+        r = cli.submit(_pb_spec())
+        assert r.job_id > 0 and r.map_epoch == 0
+        usage = cli.fetch_usage()
+        assert usage.ok and usage.shard == "east"
+        import json
+        doc = json.loads(usage.payload)
+        assert doc["user"]["u"]["submit_jobs"] == 1
+    finally:
+        if cli is not None:
+            cli.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_migrate_partition_rpc_end_to_end():
+    """``cfed migrate`` over the real wire: the source drives seal ->
+    export -> dest import -> flip -> commit; both shards' maps bump,
+    the jobs live on the dest, and post-migration submits to the old
+    owner redirect-bounce with the NEW epoch stamped."""
+    shard_map, ports, servers = _fed_pair()
+    east = west = None
+    try:
+        east = CtldClient(f"127.0.0.1:{ports['east']}")
+        west = CtldClient(f"127.0.0.1:{ports['west']}")
+        names = set()
+        for i in range(3):
+            r = east.submit(pb.JobSpec(
+                name=f"rpc{i}", user="u", partition="batch",
+                res=pb.ResourceSpec(cpu=1.0, mem_bytes=1 << 30,
+                                    memsw_bytes=1 << 30),
+                sim_runtime=30.0))
+            assert r.job_id > 0
+            names.add(f"rpc{i}")
+
+        reply = east.migrate_partition("batch", "west")
+        assert reply.ok, reply.error
+        assert reply.jobs_moved == 3 and reply.map_epoch == 1
+        assert east.query_shard_map().map_epoch == 1
+        assert west.query_shard_map().map_epoch == 1
+        moved = {j.spec.name for j in
+                 servers["west"].scheduler.pending.values()}
+        assert names <= moved
+        assert not servers["east"].scheduler.pending
+        # driving a migration from the WRONG shard names the owner
+        wrong = west.migrate_partition("debug", "west")
+        assert not wrong.ok and "east" in wrong.error
+    finally:
+        for c in (east, west):
+            if c is not None:
+                c.close()
+        for s in servers.values():
+            s.stop()

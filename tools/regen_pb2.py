@@ -293,6 +293,40 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
         ("fencing_epoch", 2, F.TYPE_UINT64),
     ])
 
+    # elastic federation (ISSUE 18): the shard map is versioned by a
+    # map epoch now — replies stamp it so clients detect a stale map
+    # and re-learn — and two new verbs carry live partition migration
+    # and the cluster-wide usage gossip (JSON payloads, the
+    # HaSnapshotReply idiom: the wire stays schema-light while the
+    # document format is owned by fed/shard.py + fed/usage.py)
+    n += _add_field(_msg(fd, "QueryShardMapReply"), "map_epoch", 4,
+                    F.TYPE_UINT64)
+    n += _add_field(_msg(fd, "SubmitJobReply"), "map_epoch", 5,
+                    F.TYPE_UINT64)
+    n += _add_message(fd, "FetchUsageRequest", [])
+    n += _add_message(fd, "FetchUsageReply", [
+        ("ok", 1, F.TYPE_BOOL),
+        ("payload", 2, F.TYPE_STRING),
+        ("shard", 3, F.TYPE_STRING),
+        ("durable_seq", 4, F.TYPE_UINT64),
+        ("error", 5, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "MigratePartitionRequest", [
+        ("partition", 1, F.TYPE_STRING),
+        ("dest_shard", 2, F.TYPE_STRING),
+        # phase "" = drive the whole migration (CLI -> source shard);
+        # "import" = adopt the payload (source shard -> dest shard)
+        ("phase", 3, F.TYPE_STRING),
+        ("payload", 4, F.TYPE_STRING),
+    ])
+    n += _add_message(fd, "MigratePartitionReply", [
+        ("ok", 1, F.TYPE_BOOL),
+        ("mid", 2, F.TYPE_STRING),
+        ("jobs_moved", 3, F.TYPE_UINT32),
+        ("map_epoch", 4, F.TYPE_UINT64),
+        ("error", 5, F.TYPE_STRING),
+    ])
+
     # gang rendezvous epochs (ISSUE 17): the coordinator tags its
     # incarnation; a member still retrying against a restarted
     # coordinator gets a typed stale-epoch rejection instead of
@@ -329,6 +363,10 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
                   "ConfirmGangReply")
     n += _add_rpc(fd, "CraneCtld", "ReleaseLease", "ReleaseLeaseRequest",
                   "OkReply")
+    n += _add_rpc(fd, "CraneCtld", "FetchUsage", "FetchUsageRequest",
+                  "FetchUsageReply")
+    n += _add_rpc(fd, "CraneCtld", "MigratePartition",
+                  "MigratePartitionRequest", "MigratePartitionReply")
     return n
 
 

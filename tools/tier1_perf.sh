@@ -200,3 +200,40 @@ print(f"TIER1_MULTIHOST_OK mesh={doc['mesh']} "
       f"fence_share={doc['fence_share']} "
       f"placed={doc['placed']}")
 PY
+
+# elastic-federation migration smoke (ISSUE 18): seal a loaded
+# partition on one live shard and hand it to another over the
+# four-phase WAL protocol, with the cluster-wide usage gossip running.
+# Asserts the handoff's acceptance shape: every job moved exactly once
+# (audited BY NAME across shards — ids renumber on import), the map
+# epoch flipped, post-flip submits route to the adopter, and the
+# submit-outage window (seal->flip) stays under 5 s at this shape.
+rb=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import bench
+print(json.dumps(bench._measure_rebalance(
+    n_jobs=400, nodes_per_part=16)))
+PY
+)
+python - "$rb" <<'PY'
+import json
+import sys
+
+doc = json.loads(sys.argv[1])
+assert doc["exactly_once"], (
+    f"migration lost, doubled, or stranded jobs: {doc['audit']} "
+    f"(full: {doc})")
+assert doc["jobs_moved"] > 0 and doc["map_epoch"] >= 1, (
+    f"the handoff moved nothing or never flipped the map: {doc}")
+assert doc["submit_outage_s"] < 5.0, (
+    f"seal->flip submit outage {doc['submit_outage_s']}s over the 5s "
+    f"budget: {doc}")
+assert doc["usage_gossip_docs"] >= 2, (
+    f"the usage gossip round exchanged fewer documents than shards: "
+    f"{doc}")
+print(f"TIER1_REBALANCE_OK jobs_moved={doc['jobs_moved']} "
+      f"handoff_s={doc['handoff_s']} "
+      f"per_job_ms={doc['per_job_ms']} "
+      f"map_epoch={doc['map_epoch']} "
+      f"gossip_ms={doc['usage_gossip_ms']}")
+PY
