@@ -110,6 +110,58 @@ def main(argv=None) -> int:
         print(f"history archive: {cfg.archive_path} "
               f"({scheduler.archive.count()} jobs)", flush=True)
 
+    # federation plane BEFORE recovery: the replay must filter
+    # committed migrations' jobs and rebuild imported node meta
+    # (fed.prepare_recovery inside recover_from_snapshot), and the
+    # UsageBook must exist before scheduler.recover backfills
+    # note_submit/note_run for boot-restored jobs — a restarted leader
+    # that published zero usage would let every peer's gate overshoot.
+    shard_map = cfg.shard_map()
+    shard_name = cfg.shard_name
+    if shard_map is not None:
+        # leases + live-migration WAL protocol ride on the scheduler
+        # (fed/shard.py self-attaches as .fed), and Federation:
+        # Limits: turns on the cluster-wide UsageBook
+        from cranesched_tpu.fed.shard import FedShardPlane
+        FedShardPlane(scheduler, shard_name)
+        limits = cfg.global_limits()
+        if limits is not None:
+            from cranesched_tpu.fed.usage import (
+                UsageBook,
+                effective_publish_slack,
+            )
+            # PublishSlack = admissions a shard may run ahead of what
+            # its slowest peer CONFIRMED pulling (the conservative
+            # gate subtracts (shards-1)*slack from every global
+            # limit); 8 absorbs a burst of submits inside one gossip
+            # interval.  Clamped so a small global limit stays
+            # satisfiable — unclamped, limit <= (shards-1)*slack
+            # would deny every submit forever.
+            asked = int((cfg.federation.get("Limits") or {})
+                        .get("PublishSlack", 8))
+            n_shards = len(shard_map.shards)
+            slack, asked = effective_publish_slack(
+                limits, n_shards, asked)
+            if slack != asked:
+                print(f"WARNING: PublishSlack={asked} leaves no "
+                      f"admissible headroom under the configured "
+                      f"global limits with {n_shards} shards — "
+                      f"clamped to {slack}",
+                      file=sys.stderr, flush=True)
+            scheduler.global_usage = UsageBook(
+                shard_name, limits,
+                n_shards=n_shards,
+                publish_slack=slack,
+                seq_source=lambda: (scheduler.wal.durable_seq
+                                    if scheduler.wal is not None
+                                    else 0),
+                peers=tuple(sorted(shard_map.shards)))
+        print(f"federation shard {shard_name!r}: "
+              f"{len(shard_map.shards)} shards, map epoch "
+              f"{shard_map.epoch}"
+              + (", global limits on" if limits is not None else ""),
+              flush=True)
+
     # recovery before serving (reference JobScheduler::Init).  A leader
     # takes the WAL-dir lease FIRST: a second ctld pointed at the same
     # WAL (operator error, or a fenced-off old leader restarting) fails
@@ -148,6 +200,20 @@ def main(argv=None) -> int:
                      if snap_seq else ""),
                   file=sys.stderr, flush=True)
         scheduler.wal = WriteAheadLog(cfg.wal_path)
+        fed = getattr(scheduler, "fed", None)
+        if fed is not None:
+            # lease tombstoning + migrated-away node re-death, and any
+            # begin with no commit/abort surfaces unresolved (the RPC
+            # server's resolve loop settles it against the dest)
+            fed.recover(time.time())
+            unresolved = fed.recover_migrations(time.time())
+            if unresolved:
+                mids = ", ".join(r["mid"] for r in unresolved)
+                print(f"WARNING: {len(unresolved)} unresolved "
+                      f"migration(s) [{mids}] — partitions stay "
+                      f"sealed until the destination's has_import "
+                      f"answer settles them",
+                      file=sys.stderr, flush=True)
         print(f"leader lease acquired (fencing epoch {epoch})",
               file=sys.stderr, flush=True)
 
@@ -180,36 +246,6 @@ def main(argv=None) -> int:
                            accounts=scheduler.accounts)
         print(f"auth enabled (token table {cfg.auth_token_file}; "
               f"root + craned tokens inside)", flush=True)
-
-    shard_map = cfg.shard_map()
-    shard_name = cfg.shard_name
-    if shard_map is not None:
-        # federation plane: leases + live-migration WAL protocol ride
-        # on the scheduler (fed/shard.py self-attaches as .fed), and
-        # Federation: Limits: turns on the cluster-wide UsageBook
-        from cranesched_tpu.fed.shard import FedShardPlane
-        FedShardPlane(scheduler, shard_name)
-        limits = cfg.global_limits()
-        if limits is not None:
-            from cranesched_tpu.fed.usage import UsageBook
-            # PublishSlack = admissions a shard may run ahead of its
-            # last gossiped summary (the conservative gate subtracts
-            # (shards-1)*slack from every global limit); 8 absorbs a
-            # burst of submits inside one gossip interval
-            slack = int((cfg.federation.get("Limits") or {})
-                        .get("PublishSlack", 8))
-            scheduler.global_usage = UsageBook(
-                shard_name, limits,
-                n_shards=len(shard_map.shards),
-                publish_slack=slack,
-                seq_source=lambda: (scheduler.wal.durable_seq
-                                    if scheduler.wal is not None
-                                    else 0))
-        print(f"federation shard {shard_name!r}: "
-              f"{len(shard_map.shards)} shards, map epoch "
-              f"{shard_map.epoch}"
-              + (", global limits on" if limits is not None else ""),
-              flush=True)
 
     metrics_port = (args.metrics_port if args.metrics_port is not None
                     else cfg.metrics_port)

@@ -199,14 +199,47 @@ class MigrationCoordinator:
         payload = src_h.export(mid, partition)
         if on_exported is not None:
             on_exported(payload)
+        jobs_imported = 0
         try:
             imported, _nodes = dst_h.import_(payload, now)
-        except Exception:
-            # the dest never adopted: annul durably and re-open the
-            # partition where it is
+            jobs_imported = len(imported)
+        except ValueError:
+            # a structured refusal: the dest's two-phase import
+            # validates and mallocs everything BEFORE its first WAL
+            # write, so this genuinely means "not adopted" — annul
+            # durably and re-open the partition where it is
             src_h.abort(mid, partition, now)
             _MET_MIG_ABORTS.inc()
             raise
+        except Exception as exc:
+            # the call died in flight — AMBIGUOUS: the dest may hold
+            # the jobs durably (and a retried handle call may have
+            # been the one that landed).  A blind abort here would
+            # leave BOTH shards owning the jobs; ask the dest instead.
+            try:
+                adopted = bool(dst_h.has_import(mid))
+            except Exception:
+                adopted = None
+            if adopted is None:
+                # dest unreachable: the only safe move is none — the
+                # partition stays sealed (no admits, no duplicates on
+                # either side) and resolve() settles the begin later
+                self.pending_resolution.append(
+                    {"mid": mid, "partition": partition,
+                     "source": source, "dest": dest,
+                     "job_ids": list(job_ids)})
+                raise RuntimeError(
+                    f"dest {dest!r} unreachable after import ({exc}); "
+                    f"partition {partition!r} stays sealed pending "
+                    "resolution") from exc
+            if not adopted:
+                src_h.abort(mid, partition, now)
+                _MET_MIG_ABORTS.inc()
+                raise
+            # adopted after all: fall through to flip + commit (the
+            # exact dest-local ids live on the dest; the source only
+            # needs the fact of adoption)
+            jobs_imported = len(job_ids)
         # dest holds the jobs durably — the map may flip.  Flip BEFORE
         # the source commit: if the source dies in between, routing
         # already points at the shard that has the jobs, and resolve()
@@ -227,26 +260,55 @@ class MigrationCoordinator:
         return {"mid": mid, "partition": partition, "source": source,
                 "dest": dest, "epoch": new_map.epoch,
                 "jobs_sealed": len(job_ids),
-                "jobs_imported": len(imported),
+                "jobs_imported": jobs_imported,
                 "committed": committed}
 
     def resolve(self, source: str, now: float) -> list[dict]:
-        """Settle a restarted source's unresolved begins: for each, ask
-        the recorded dest whether the import happened — commit (the
-        jobs live there; drop the source copies) or abort (they never
-        left; unseal).  Also drains :attr:`pending_resolution` entries
-        for this source."""
+        """Settle ``source``'s unresolved begins (surfaced by its
+        recovery, or queued here after an ambiguous import call): for
+        each, ask the recorded dest whether the import happened —
+        commit (the jobs live there; drop the source copies, and make
+        sure the map routes to the dest first) or abort (they never
+        left; unseal).  A dest that cannot ANSWER leaves its begin
+        pending and the partition sealed — never guess: a blind abort
+        against a dest that did adopt doubles every job."""
         src_h = self.handles[source]
+        queued = [r for r in self.pending_resolution
+                  if r["source"] == source]
         self.pending_resolution = [
             r for r in self.pending_resolution if r["source"] != source]
+        seen = set()
+        records = []
+        for rec in list(src_h.unresolved()) + queued:
+            if rec["mid"] in seen:
+                continue
+            seen.add(rec["mid"])
+            records.append(rec)
         out = []
-        for rec in src_h.unresolved():
+        for rec in records:
             dst_h = self.handles.get(rec.get("dest", ""))
-            if dst_h is not None and dst_h.has_import(rec["mid"]):
+            adopted = None
+            if dst_h is not None:
+                try:
+                    adopted = bool(dst_h.has_import(rec["mid"]))
+                except Exception:
+                    adopted = None
+            if adopted is True:
+                if (self.shard_map.shard_for_partition(rec["partition"])
+                        != rec["dest"]):
+                    new_map = self.shard_map.with_partition_moved(
+                        rec["partition"], rec["dest"])
+                    self.flip_map(new_map)
+                    self.shard_map = new_map
+                    _MET_MAP_EPOCH.set(new_map.epoch)
                 src_h.commit(rec["mid"], rec["partition"], now)
                 out.append(dict(rec, resolution="commit"))
-            else:
+            elif adopted is False:
                 src_h.abort(rec["mid"], rec["partition"], now)
                 _MET_MIG_ABORTS.inc()
                 out.append(dict(rec, resolution="abort"))
+            else:
+                self.pending_resolution.append(
+                    dict(rec, source=source))
+                out.append(dict(rec, resolution="pending"))
         return out

@@ -43,12 +43,16 @@ four durable phases, each its own record:
     its nodes release — and the intent (mid, dest, job_ids) is durable.
 
 ``fed_migrate_import`` (dest)
-    The ONLY record that creates jobs on the destination.  The whole
-    handoff payload lands in one WAL group: node inventory adopted by
-    NAME (ids are shard-local), every pending/running job re-created
-    under a fresh dest-local id, then the import record.  A crash
-    before the group's fsync imports nothing; after, everything —
-    never half a partition.
+    The ONLY record that creates jobs on the destination.  The import
+    validates and mallocs EVERY job first, before a single record is
+    appended — a refusal (unknown node, placement that no longer
+    fits) rolls the mallocs back and writes NOTHING, so a structured
+    import error genuinely means "not adopted".  Then the whole
+    handoff lands in one WAL group: node inventory adopted by NAME
+    (ids are shard-local), every pending/running job re-created under
+    a fresh dest-local id, then the import record.  A crash before
+    the group's fsync imports nothing; after, everything — never half
+    a partition.
 
 ``fed_migrate_commit`` (source)
     Written once the dest durably holds the jobs and the successor map
@@ -62,10 +66,28 @@ four durable phases, each its own record:
     The handoff never reached the dest: unseal, keep everything.
 
 A source SIGKILL mid-handoff leaves a begin without commit/abort;
-:meth:`recover_migrations` surfaces it and the coordinator resolves by
+recovery surfaces it and the coordinator/resolver settles it by
 asking the dest :meth:`has_import` — imported means commit (the jobs
 live there), not imported means abort (they never left).  Exactly one
 shard ends up owning every job either way.
+
+Recovery splits in two around the ordinary job replay:
+
+:meth:`prepare_recovery`
+    BEFORE ``scheduler.recover``: rebuild imported partitions' node
+    meta (in original adoption order, so node ids renumber
+    identically and replayed placements stay valid), filter
+    committed-migration job_ids out of the replay (they live on the
+    dest now), re-seal in-flight/migrated partitions, and re-seed the
+    imports/begun tables.  State comes from the HA snapshot's ``fed``
+    document first — the snapshotter prunes WAL segments a snapshot
+    covers, fed_migrate_* records included — then the surviving WAL
+    records overlay it.
+
+:meth:`recover_migrations`
+    AFTER ``scheduler.recover``: re-mark migrated-away partitions'
+    nodes dead and surface begins with no commit/abort as
+    :attr:`unresolved_migrations` for the resolver.
 """
 
 from __future__ import annotations
@@ -81,7 +103,11 @@ from cranesched_tpu.ctld.defs import (
     PendingReason,
 )
 from cranesched_tpu.ctld.meta import ResReduceEvent
-from cranesched_tpu.ctld.wal import _job_from_dict, _job_to_dict
+from cranesched_tpu.ctld.wal import (
+    WriteAheadLog,
+    _job_from_dict,
+    _job_to_dict,
+)
 from cranesched_tpu.obs import REGISTRY as _OBS
 
 _MET_LEASES = _OBS.counter(
@@ -126,6 +152,20 @@ class FedShardPlane:
         #: partitions this shard handed away (their nodes stay in meta,
         #: dead, so shard-local node ids never renumber)
         self.migrated_away: set[str] = set()
+        #: ordered adoption records (mid, partition, priority, nodes)
+        #: — the HA snapshot carries these so a dest restart can
+        #: rebuild imported node meta even after the covering WAL
+        #: segments were pruned; order IS the node-id renumbering
+        self.import_meta: list[dict] = []
+        #: mid -> begin payload for migrations this shard STARTED and
+        #: has not yet committed/aborted; snapshotted alongside
+        #: import_meta so an in-flight begin survives segment pruning
+        self.begun: dict[str, dict] = {}
+        #: begins recovery could not settle locally — the partition
+        #: stays sealed until a resolver confirms the dest's
+        #: has_import answer (rpc/server.py's resolve loop, the
+        #: coordinator's resolve(), or an operator)
+        self.unresolved_migrations: list[dict] = []
 
     # -- reserve --
 
@@ -383,6 +423,8 @@ class FedShardPlane:
             self.release_lease(lid, now, detail="partition migrating")
         sched.sealed_partitions.add(partition)
         job_ids = self.partition_jobs(partition)
+        self.begun[str(mid)] = {"mid": str(mid), "partition": partition,
+                                "dest": dest, "job_ids": list(job_ids)}
         if sched.wal is not None:
             sched.wal.fed_event("fed_migrate_begin", {
                 "mid": str(mid), "partition": partition, "dest": dest,
@@ -452,17 +494,27 @@ class FedShardPlane:
                 nid = node.node_id
                 meta.craned_up(nid)
                 new_nodes.append(nid)
+            elif (not meta.nodes[nid].alive
+                  and partition in meta.nodes[nid].partitions):
+                # a prior refused attempt left the node parked dead —
+                # revive it for this retry
+                meta.craned_up(nid)
+                new_nodes.append(nid)
         entries = sorted(payload.get("jobs", []) or [],
                          key=lambda e: e["job"]["job_id"])
         idmap: dict[int, int] = {}
         for entry in entries:
             idmap[int(entry["job"]["job_id"])] = sched._next_job_id
             sched._next_job_id += 1
-        wal = sched.wal
-        imported: list[int] = []
+        # Phase A — validate and malloc EVERYTHING before a single
+        # record is appended: commit_batch flushes partial groups even
+        # on error, so a refusal discovered mid-write would half-import
+        # durably.  An exception here rolls back every malloc, parks
+        # the adopted nodes dead, and writes NOTHING — a structured
+        # import error genuinely means "not adopted".
+        jobs: list = []
+        mallocd: list[tuple[int, list[int], object]] = []
         try:
-            if wal is not None:
-                wal.begin_batch()
             for entry in entries:
                 job = _job_from_dict(entry["job"])
                 job.job_id = idmap[int(entry["job"]["job_id"])]
@@ -470,13 +522,31 @@ class FedShardPlane:
                                 entry.get("node_names") or [])
                 if job.status in (JobStatus.RUNNING,
                                   JobStatus.SUSPENDED):
+                    alloc = sched._job_alloc(job)
                     if not meta.malloc_resource(job.job_id,
-                                                job.node_ids,
-                                                sched._job_alloc(job)):
+                                                job.node_ids, alloc):
                         raise ValueError(
                             f"imported nodes cannot hold job "
                             f"{entry['job']['job_id']} "
                             f"(mid={mid}, part={partition})")
+                    mallocd.append((job.job_id, list(job.node_ids),
+                                    alloc))
+                jobs.append(job)
+        except Exception:
+            for jid, nids, alloc in mallocd:
+                meta.free_resource(jid, nids, alloc)
+            for nid in new_nodes:
+                meta.craned_down(nid)
+            raise
+        # Phase B — everything fits: bookkeeping plus ONE WAL group.
+        wal = sched.wal
+        imported: list[int] = []
+        try:
+            if wal is not None:
+                wal.begin_batch()
+            for job in jobs:
+                if job.status in (JobStatus.RUNNING,
+                                  JobStatus.SUSPENDED):
                     sched.licenses.restore(job.spec.licenses or {})
                     if sched.account_meta is not None and job.qos_name:
                         sched.account_meta.restore_run(
@@ -524,6 +594,10 @@ class FedShardPlane:
             if wal is not None:
                 wal.commit_batch()
         self.imports[mid] = list(imported)
+        self.import_meta.append({
+            "mid": mid, "partition": partition,
+            "priority": int(payload.get("priority", 0)),
+            "nodes": [dict(d) for d in payload.get("nodes", []) or []]})
         sched.events.emit(
             "fed_migrate_import", "info", time=now,
             detail=f"mid={mid} part={partition} jobs={len(imported)} "
@@ -618,6 +692,10 @@ class FedShardPlane:
                 if meta.nodes[nid].alive:
                     meta.craned_down(nid)
         self.migrated_away.add(partition)
+        self.begun.pop(str(mid), None)
+        self.unresolved_migrations = [
+            r for r in self.unresolved_migrations
+            if r.get("mid") != str(mid)]
         sched.events.emit(
             "fed_migrate_commit", "info", time=now,
             detail=f"mid={mid} part={partition} "
@@ -633,56 +711,139 @@ class FedShardPlane:
             sched.wal.fed_event("fed_migrate_abort", {
                 "mid": str(mid), "partition": partition})
         sched.sealed_partitions.discard(partition)
+        self.begun.pop(str(mid), None)
+        self.unresolved_migrations = [
+            r for r in self.unresolved_migrations
+            if r.get("mid") != str(mid)]
         sched.events.emit(
             "fed_migrate_abort", "warning", time=now,
             detail=f"mid={mid} part={partition}")
 
-    def recover_migrations(self, now: float) -> list[dict]:
-        """Post-replay migration cleanup (runs AFTER the caller already
-        filtered committed migrations' jobs out of the replay — see
-        ``WriteAheadLog.replay_migrations``):
+    def _adopt_meta(self, rec: dict) -> None:
+        """Recreate one adoption's partition + node meta (recovery
+        path; mirrors the live import's inventory adoption, so node
+        ids renumber identically and replayed placements stay valid)."""
+        meta = self.scheduler.meta
+        partition = str(rec["partition"])
+        if partition not in meta.partitions:
+            meta.add_partition(partition,
+                               priority=int(rec.get("priority", 0)))
+        for doc in rec.get("nodes", []) or []:
+            nid = meta._name_to_id.get(doc["name"])
+            if nid is None:
+                node = meta.add_node(
+                    doc["name"], np.asarray(doc["total"], np.int32),
+                    partitions=doc.get("partitions") or (partition,))
+                meta.craned_up(node.node_id)
 
-        * import records re-seed :attr:`imports` (the source may still
-          call :meth:`has_import`),
-        * commit records re-seal the partition and re-mark its nodes
-          dead,
-        * a begin with no commit/abort is returned UNRESOLVED — the
-          partition re-seals and the coordinator must resolve it
-          against the dest before it moves again.
+    def snapshot_doc(self) -> dict:
+        """Migration state for the HA snapshot.  The snapshotter
+        prunes WAL segments a snapshot covers — ``fed_migrate_*``
+        records included — so the snapshot itself must carry enough to
+        rebuild imported node meta, the committed-migration replay
+        filter, and in-flight begins across a restart."""
+        return {
+            "imports": {m: list(ids)
+                        for m, ids in sorted(self.imports.items())},
+            "import_meta": [dict(e) for e in self.import_meta],
+            "migrated_away": sorted(self.migrated_away),
+            "sealed": sorted(self.scheduler.sealed_partitions),
+            "begun": [dict(self.begun[m]) for m in sorted(self.begun)],
+        }
+
+    def prepare_recovery(self, wal_path, replayed: dict,
+                         snap_fed: dict | None = None) -> None:
+        """BEFORE ``scheduler.recover``: fold migration history into
+        the replay.  ``replayed`` is the job_id -> job dict the WAL
+        replay assembled (mutated in place); ``snap_fed`` is the HA
+        snapshot's ``fed`` document, applied first, with the surviving
+        WAL records overlaid on top.
+
+        * imported partitions' node meta is rebuilt in original
+          adoption order and :attr:`imports` re-seeds (the source may
+          still ask :meth:`has_import`),
+        * committed migrations' job_ids drop out of ``replayed`` (the
+          jobs live on the dest now) and the partition re-seals,
+        * a begin with no commit/abort re-seals its partition and
+          re-seeds :attr:`begun` for :meth:`recover_migrations` to
+          surface as unresolved.
         """
         sched = self.scheduler
-        if sched.wal is None:
-            return []
-        unresolved: list[dict] = []
-        state = sched.wal.replay_migrations(sched.wal.path)
-        for mid, entry in sorted(state.items()):
+        if snap_fed:
+            for rec in snap_fed.get("import_meta", []) or []:
+                self._adopt_meta(rec)
+                self.import_meta.append(dict(rec))
+            for m, ids in (snap_fed.get("imports") or {}).items():
+                self.imports[str(m)] = list(ids)
+            self.migrated_away.update(
+                str(p) for p in snap_fed.get("migrated_away", []) or [])
+            for p in snap_fed.get("sealed", []) or []:
+                sched.sealed_partitions.add(str(p))
+            for rec in snap_fed.get("begun", []) or []:
+                self.begun[str(rec["mid"])] = dict(rec)
+        migs = (WriteAheadLog.replay_migrations(wal_path)
+                if wal_path else {})
+        for mid, entry in sorted(migs.items(),
+                                 key=lambda kv: kv[1].get("seq", 0)):
             ev = entry.get("ev", "")
             partition = str(entry.get("partition", ""))
             if ev == "fed_migrate_import":
+                if mid in self.imports:
+                    continue  # the snapshot already carried it
+                self._adopt_meta(entry)
                 self.imports[mid] = list(entry.get("job_ids") or [])
-            elif ev == "fed_migrate_commit":
-                sched.sealed_partitions.add(partition)
-                self.migrated_away.add(partition)
-                part = sched.meta.partitions.get(partition)
-                if part is not None:
-                    for nid in sorted(part.node_ids):
-                        if sched.meta.nodes[nid].alive:
-                            sched.meta.craned_down(nid)
+                self.import_meta.append({
+                    "mid": mid, "partition": partition,
+                    "priority": int(entry.get("priority", 0)),
+                    "nodes": [dict(d)
+                              for d in entry.get("nodes", []) or []]})
             elif ev == "fed_migrate_begin":
                 sched.sealed_partitions.add(partition)
-                unresolved.append({"mid": mid, "partition": partition,
-                                   "dest": str(entry.get("dest", "")),
-                                   "job_ids": list(
-                                       entry.get("job_ids") or [])})
-                sched.events.emit(
-                    "fed_migrate_unresolved", "warning", time=now,
-                    detail=f"mid={mid} part={partition} "
-                           "(begin without commit/abort — resolving "
-                           "against the destination)")
+                self.begun[mid] = {
+                    "mid": mid, "partition": partition,
+                    "dest": str(entry.get("dest", "")),
+                    "job_ids": list(entry.get("job_ids") or [])}
+            elif ev == "fed_migrate_commit":
+                for jid in entry.get("job_ids") or []:
+                    replayed.pop(jid, None)
+                sched.sealed_partitions.add(partition)
+                self.migrated_away.add(partition)
+                self.begun.pop(mid, None)
+            elif ev == "fed_migrate_abort":
+                self.begun.pop(mid, None)
+                if partition not in self.migrated_away:
+                    sched.sealed_partitions.discard(partition)
+
+    def recover_migrations(self, now: float) -> list[dict]:
+        """AFTER ``scheduler.recover``: re-mark migrated-away
+        partitions' nodes dead (recover marks observed nodes up) and
+        surface begins with no commit/abort as
+        :attr:`unresolved_migrations` — each partition stays sealed
+        until the resolver settles its begin against the dest's
+        :meth:`has_import` answer (commit if adopted, abort if not)."""
+        sched = self.scheduler
+        meta = sched.meta
+        for partition in sorted(self.migrated_away):
+            sched.sealed_partitions.add(partition)
+            part = meta.partitions.get(partition)
+            if part is not None:
+                for nid in sorted(part.node_ids):
+                    if meta.nodes[nid].alive:
+                        meta.craned_down(nid)
+        unresolved = [dict(self.begun[m]) for m in sorted(self.begun)]
+        for rec in unresolved:
+            sched.events.emit(
+                "fed_migrate_unresolved", "warning", time=now,
+                detail=f"mid={rec['mid']} part={rec['partition']} "
+                       "(begin without commit/abort — resolving "
+                       "against the destination)")
+        self.unresolved_migrations = unresolved
         return unresolved
 
     def stats(self) -> dict:
         return {"shard": self.shard, "leases": len(self.leases),
                 "sealed": sorted(self.scheduler.sealed_partitions),
                 "migrated_away": sorted(self.migrated_away),
-                "imports": len(self.imports)}
+                "imports": len(self.imports),
+                "begun": len(self.begun),
+                "unresolved": len(self.unresolved_migrations)}

@@ -23,8 +23,6 @@ from __future__ import annotations
 
 import threading
 
-import numpy as np
-
 from cranesched_tpu.craned.sim import SimCluster
 from cranesched_tpu.ctld.defs import JobSpec
 from cranesched_tpu.ctld.meta import MetaContainer
@@ -45,7 +43,7 @@ class SimShard:
                  cpu: float = 16.0, mem_gb: int = 64,
                  wal_path: str | None = None, config_kw=None,
                  global_limits=None, n_shards: int = 1,
-                 publish_slack: int = 1):
+                 publish_slack: int = 1, peers=()):
         self.name = name
         self.partitions = dict(partitions)
         self.cpu = cpu
@@ -55,6 +53,7 @@ class SimShard:
         self.global_limits = global_limits
         self.n_shards = n_shards
         self.publish_slack = publish_slack
+        self.peers = tuple(peers)
         self.lock = threading.Lock()
         self.alive = True
         #: failure injection: die immediately after the NEXT successful
@@ -69,7 +68,7 @@ class SimShard:
 
     # -- construction / recovery --
 
-    def _build(self, now: float, replayed) -> None:
+    def _build(self, now: float, replayed, snap_fed=None) -> None:
         self.meta = MetaContainer(ResourceLayout())
         nid = 0
         # native partitions build in sorted order, ALWAYS — including
@@ -86,40 +85,17 @@ class SimShard:
                     partitions=(part,))
                 self.meta.craned_up(nid)
                 nid += 1
-        migs = {}
-        if replayed is not None and self.wal_path is not None:
-            migs = WriteAheadLog.replay_migrations(self.wal_path)
-            # partitions adopted by live migration re-create their meta
-            # in import order (seq), AFTER the native nodes — the same
-            # append order the live import used, so node ids re-number
-            # identically and replayed placements stay valid
-            for entry in sorted(migs.values(),
-                                key=lambda e: e.get("seq", 0)):
-                if entry.get("ev") != "fed_migrate_import":
-                    continue
-                part = str(entry.get("partition", ""))
-                if part not in self.meta.partitions:
-                    self.meta.add_partition(
-                        part, priority=int(entry.get("priority", 0)))
-                for doc in entry.get("nodes", []) or []:
-                    if doc["name"] in self.meta._name_to_id:
-                        continue
-                    node = self.meta.add_node(
-                        doc["name"],
-                        np.asarray(doc["total"], np.int32),
-                        partitions=doc.get("partitions") or (part,))
-                    self.meta.craned_up(node.node_id)
-            # jobs handed off by a committed migration must NOT
-            # resurrect from their (non-terminal) job records: the
-            # commit record is the filter
-            for entry in migs.values():
-                if entry.get("ev") == "fed_migrate_commit":
-                    for jid in entry.get("job_ids") or []:
-                        replayed.pop(jid, None)
         kw = dict(self.config_kw)
         kw.setdefault("job_trace", True)
         kw.setdefault("job_trace_capacity", 65536)
         self.scheduler = JobScheduler(self.meta, SchedulerConfig(**kw))
+        # the fed plane attaches BEFORE recovery — prepare_recovery is
+        # what rebuilds imported partitions' meta (in original adoption
+        # order, so node ids renumber identically), filters committed
+        # migrations' jobs out of the replay, and re-seals in-flight
+        # partitions.  The production boot (ctld_main + ha/snapshot)
+        # runs the same sequence.
+        self.fed = FedShardPlane(self.scheduler, self.name)
         if self.global_limits is not None:
             # before recover: restored jobs must re-take their global
             # submit slots (fed/usage.py)
@@ -128,8 +104,11 @@ class SimShard:
                 publish_slack=self.publish_slack,
                 seq_source=lambda: (self.scheduler.wal.durable_seq
                                     if self.scheduler.wal is not None
-                                    else 0))
+                                    else 0),
+                peers=self.peers)
         if replayed is not None:
+            self.fed.prepare_recovery(self.wal_path, replayed,
+                                      snap_fed=snap_fed)
             self.scheduler.recover(replayed, now)
         if self.wal_path is not None:
             if self._fresh_wal:
@@ -139,7 +118,6 @@ class SimShard:
         self.sim = SimCluster(self.scheduler)
         self.sim.now = now
         self.sim.wire(self.scheduler)
-        self.fed = FedShardPlane(self.scheduler, self.name)
         self.unresolved_migrations = []
         if replayed is not None:
             self.fed.recover(now)
@@ -155,11 +133,31 @@ class SimShard:
         self.alive = False
 
     def recover(self, now: float) -> None:
-        """Restart from the WAL (requires ``wal_path``)."""
+        """Restart from the local snapshot (if one exists beside the
+        WAL — the same ``<wal>.snap`` the HA snapshotter writes) plus
+        the WAL tail, or a full WAL replay otherwise.  The snapshot's
+        ``fed`` document stands in for fed_migrate_* records that
+        segment pruning dropped."""
         if self.wal_path is None:
             raise RuntimeError("recover needs a WAL-backed shard")
-        replayed = WriteAheadLog.replay(self.wal_path)
-        self._build(now=now, replayed=replayed)
+        from cranesched_tpu.ha.snapshot import (
+            SnapshotStore,
+            snapshot_to_replay,
+        )
+        doc = SnapshotStore(self.wal_path).load()
+        snap_fed = None
+        if doc is not None:
+            replayed = snapshot_to_replay(doc)
+            replayed.update(WriteAheadLog.replay(
+                self.wal_path, after_seq=int(doc.get("seq", 0))))
+            snap_fed = doc.get("fed")
+        else:
+            replayed = WriteAheadLog.replay(self.wal_path)
+        self._build(now=now, replayed=replayed, snap_fed=snap_fed)
+        if doc is not None:
+            self.scheduler._next_job_id = max(
+                self.scheduler._next_job_id,
+                int(doc.get("next_job_id", 1)))
         self.alive = True
 
     # -- the local control surface (what the RPC handlers would do) --
@@ -310,7 +308,8 @@ class FederatedCluster:
                 name, shards[name], cpu=cpu, mem_gb=mem_gb,
                 wal_path=wal_path, config_kw=config_kw,
                 global_limits=global_limits, n_shards=len(shards),
-                publish_slack=publish_slack)
+                publish_slack=publish_slack,
+                peers=tuple(p for p in sorted(shards) if p != name))
             specs.append(ShardSpec(
                 name=name,
                 partitions=tuple(sorted(shards[name]))))
@@ -389,25 +388,37 @@ class FederatedCluster:
         return self.coordinator.resolve(source, self.now)
 
     def pump_usage(self, now: float | None = None) -> int:
-        """One gossip round: every live shard publishes its UsageBook
-        summary and ingests everyone else's.  Returns the number of
-        documents exchanged.  Call cadence IS the staleness bound —
-        every tick approximates staleness 0, sparser pumping exercises
-        the conservative slack (fed/usage.py)."""
+        """One gossip round: every live shard PULLS every live peer's
+        summary, exactly as the RPC loop does — each pull publishes
+        with the puller's name, so the publisher marks its counters
+        delivered to that peer (per-peer acks are what release the
+        publish-slack throttle; a dead peer withholds its ack and the
+        publisher's own admissions tighten instead of overshooting).
+        Returns the number of documents exchanged.  Call cadence IS
+        the staleness bound — every tick approximates staleness 0,
+        sparser pumping exercises the conservative slack
+        (fed/usage.py)."""
         now = self.now if now is None else now
-        docs = []
-        for shard in self.shards.values():
-            book = shard.scheduler.global_usage
-            if shard.alive and book is not None:
-                with shard.lock:
-                    docs.append(book.publish(now))
-        for shard in self.shards.values():
-            book = shard.scheduler.global_usage
-            if shard.alive and book is not None:
-                with shard.lock:
-                    for doc in docs:
-                        book.ingest(doc, now)
-        return len(docs)
+        exchanged = 0
+        names = sorted(self.shards)
+        for dst_name in names:
+            dst = self.shards[dst_name]
+            dbook = dst.scheduler.global_usage
+            if not dst.alive or dbook is None:
+                continue
+            for src_name in names:
+                if src_name == dst_name:
+                    continue
+                src = self.shards[src_name]
+                sbook = src.scheduler.global_usage
+                if not src.alive or sbook is None:
+                    continue
+                with src.lock:
+                    doc = sbook.publish(now, peer=dst_name)
+                with dst.lock:
+                    dbook.ingest(doc, now)
+                exchanged += 1
+        return exchanged
 
     # -- failure injection / audit --
 

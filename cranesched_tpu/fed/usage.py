@@ -21,15 +21,24 @@ L by however many admissions every other shard performed since it last
 published.  The book therefore enforces two rules:
 
 1. *Publish throttle*: a shard that has admitted ``publish_slack``
-   (B) jobs since its last publish stops admitting until it publishes
-   again.  This caps every shard's unpublished admissions at B, so
-   for any observer ``true_remote <= known_remote + (S-1)*B``.
+   (B) jobs beyond what its slowest peer has CONFIRMED receiving
+   stops admitting until that peer pulls again.  Delivery is what
+   counts, not the act of building a summary document: each admission
+   bumps a monotone ``_admitted`` counter, and a peer's successful
+   pull (FetchUsage with its shard name / the sim's pump) records an
+   ack at the current counter.  The throttle gates on
+   ``_admitted - min(peer acks)`` — so for ANY observer, at every
+   instant, ``true_remote <= known_remote + (S-1)*B``.  (A book built
+   without a ``peers`` roster falls back to acking on publish itself —
+   the single-consumer sim/unit-test shape.)
 2. *Conservative gate*: admit only while
    ``local + known_remote + 1 <= L - (S-1)*B``.
 
 Together: the cluster-wide count can NEVER exceed L — the documented
 overshoot bound is zero; staleness converts into early (conservative)
-denials of at most ``(S-1)*B`` slots, never into an overshoot.
+denials of at most ``(S-1)*B`` slots, never into an overshoot.  A peer
+that stops pulling freezes its ack, so this shard stops admitting at
+B-beyond-acked instead of silently outrunning what that peer knows.
 Decrements (job finish) travelling late only make ``known_remote`` an
 over-estimate, which again errs toward denial.  With ``B = 0`` the
 operator promises synchronous publishing (publish after every
@@ -114,7 +123,8 @@ class UsageBook:
 
     def __init__(self, shard: str, limits: GlobalLimits | None = None,
                  n_shards: int = 1, publish_slack: int = 1,
-                 seq_source: Callable[[], int] | None = None):
+                 seq_source: Callable[[], int] | None = None,
+                 peers: tuple = ()):
         self.shard = shard
         self.limits = limits or GlobalLimits()
         self.n_shards = max(int(n_shards), 1)
@@ -127,7 +137,16 @@ class UsageBook:
         # shard -> its last published doc (ingested verbatim)
         self._remote: dict[str, dict] = {}
         self._remote_at: dict[str, float] = {}  # local receive time
-        self._unpublished = 0
+        # delivery-confirmed throttle state: admissions are a monotone
+        # counter, each peer acks the counter value it has seen (its
+        # last successful pull), and the throttle gates on the SLOWEST
+        # peer's lag — never on the act of building a document
+        self.peers = tuple(p for p in peers if p and p != shard)
+        self._admitted = 0
+        self._peer_acked: dict[str, int] = {p: 0 for p in self.peers}
+        # no-roster fallback (direct construction in unit tests / the
+        # single-consumer sim): publish() itself counts as delivery
+        self._published_floor = 0
         self.denied = 0
 
     # ---- local bookkeeping (scheduler hooks) ----
@@ -144,7 +163,7 @@ class UsageBook:
         self._c(self._user, user).submit_jobs += 1
         if account:
             self._c(self._acct, account).submit_jobs += 1
-        self._unpublished += 1
+        self._admitted += 1
 
     def note_release_submit(self, user: str, account: str) -> None:
         u = self._user.get(user)
@@ -162,7 +181,7 @@ class UsageBook:
             a = self._c(self._acct, account)
             a.jobs = max(a.jobs + delta, 0)
         if delta > 0:
-            self._unpublished += delta
+            self._admitted += delta
 
     def reserve_run(self, user: str, account: str) -> None:
         """Hold a run slot between admission and the running-dict
@@ -187,6 +206,16 @@ class UsageBook:
     def _slack(self) -> int:
         return (self.n_shards - 1) * self.publish_slack
 
+    def unconfirmed(self) -> int:
+        """Admissions the slowest consumer has NOT confirmed seeing —
+        the quantity the publish throttle bounds at ``publish_slack``.
+        With a peer roster this is the monotone admission counter minus
+        the minimum per-peer ack; without one (no ``peers`` given),
+        admissions since the last :meth:`publish`."""
+        if self._peer_acked:
+            return self._admitted - min(self._peer_acked.values())
+        return self._admitted - self._published_floor
+
     def _remote_sum(self, table: str, key: str, field: str) -> int:
         total = 0
         for doc in self._remote.values():
@@ -205,13 +234,14 @@ class UsageBook:
         if not lim.any_set:
             return ""
         if (self.publish_slack > 0
-                and self._unpublished >= self.publish_slack):
-            # rule 1: our own count is about to outrun what the other
-            # shards know about us — publish before admitting more
+                and self.unconfirmed() >= self.publish_slack):
+            # rule 1: our own count is about to outrun what the
+            # slowest peer has CONFIRMED knowing about us — hold
+            # admissions until it pulls again
             self.denied += 1
             _MET_DENIED.inc()
             return ("global limit gate: usage publish overdue "
-                    f"({self._unpublished} unpublished admissions)")
+                    f"({self.unconfirmed()} unconfirmed admissions)")
         slack = self._slack()
         checks = [("user", user, lim.max_submit_jobs_per_user,
                    "global MaxSubmitJobsPerUser")]
@@ -240,7 +270,7 @@ class UsageBook:
         if not lim.any_set:
             return ""
         if (self.publish_slack > 0
-                and self._unpublished >= self.publish_slack):
+                and self.unconfirmed() >= self.publish_slack):
             self.denied += 1
             _MET_DENIED.inc()
             return "global limit gate: usage publish overdue"
@@ -266,10 +296,17 @@ class UsageBook:
 
     # ---- the gossip wire (FetchUsage / the sim's pump) ----
 
-    def publish(self, now: float) -> dict:
-        """This shard's usage summary, durable_seq-stamped.  Resets the
-        publish throttle: the counts below are exactly what the other
-        shards will know about us."""
+    def publish(self, now: float, peer: str = "") -> dict:
+        """This shard's usage summary, durable_seq-stamped.
+
+        ``peer`` names the shard this document is being DELIVERED to
+        (the FetchUsage handler passes the puller's shard name, under
+        the same lock that built the document): that peer's throttle
+        ack advances to the current admission counter — the counts
+        below are exactly what it will know about us.  An anonymous
+        publish (CLI inspection, ``peer=""``) releases nothing, unless
+        the book has no peer roster at all (the no-roster fallback
+        treats any publish as the one consumer's delivery)."""
         doc = {
             "shard": self.shard,
             "time": now,
@@ -282,7 +319,10 @@ class UsageBook:
                      for a, c in sorted(self._acct.items())
                      if c.jobs or c.submit_jobs},
         }
-        self._unpublished = 0
+        if peer and peer in self._peer_acked:
+            self._peer_acked[peer] = self._admitted
+        elif not self._peer_acked:
+            self._published_floor = self._admitted
         _MET_PUBLISH.inc()
         return doc
 
@@ -302,9 +342,12 @@ class UsageBook:
         _MET_STALENESS.set(self.staleness(now), shard=self.shard)
 
     def forget(self, shard: str) -> None:
-        """Drop a departed shard's summary (map shrink)."""
+        """Drop a departed shard's summary (map shrink) — and its
+        throttle ack, so a removed peer cannot freeze admissions
+        forever."""
         self._remote.pop(shard, None)
         self._remote_at.pop(shard, None)
+        self._peer_acked.pop(shard, None)
 
     def staleness(self, now: float) -> float:
         """Age of the OLDEST remote summary held; 0 with no remotes
@@ -332,9 +375,36 @@ class UsageBook:
     def stats(self) -> dict:
         return {
             "shard": self.shard,
-            "unpublished": self._unpublished,
+            "unpublished": self.unconfirmed(),
+            "admitted": self._admitted,
+            "peer_acked": dict(self._peer_acked),
             "remotes": sorted(self._remote),
             "denied": self.denied,
             "users": {u: dataclasses.asdict(c)
                       for u, c in sorted(self._user.items())},
         }
+
+
+def effective_publish_slack(limits: GlobalLimits, n_shards: int,
+                            slack: int) -> tuple[int, int]:
+    """Clamp ``slack`` so the conservative gate stays satisfiable.
+
+    The gate admits only while ``known + 1 <= L - (S-1)*B``; a
+    configured B with ``(S-1)*B >= L`` for any finite global limit L
+    would deny EVERY admission forever, even on an idle cluster.  The
+    largest satisfiable B leaves at least one admissible slot under
+    the smallest limit: ``B <= (L_min - 1) // (S - 1)``.
+
+    Returns ``(effective, configured)`` — ``effective < configured``
+    means the caller should warn loudly that staleness tolerance was
+    reduced to keep the limits reachable."""
+    slack = max(int(slack), 0)
+    finite = [v for v in (limits.max_jobs_per_user,
+                          limits.max_submit_jobs_per_user,
+                          limits.max_jobs_per_account,
+                          limits.max_submit_jobs_per_account)
+              if v != UNLIMITED]
+    if not finite or n_shards < 2 or slack == 0:
+        return slack, slack
+    max_ok = max((min(finite) - 1) // (n_shards - 1), 0)
+    return min(slack, max_ok), slack

@@ -84,6 +84,10 @@ class HaFollower(threading.Thread):
         # replay-shaped shadow state: job_id -> (ev, Job); the same Job
         # objects are mirrored into the scheduler dicts for queries
         self._state: dict = {}
+        # the leader snapshot's "fed" document — migration state that
+        # promotion must fold into the replay (prune_segments already
+        # dropped the covered fed_migrate_* records on the leader)
+        self._snap_fed: dict | None = None
         self._have_snapshot = False
         self._seed_from_disk()
 
@@ -96,6 +100,7 @@ class HaFollower(threading.Thread):
         doc = self.store.load()
         if doc is not None:
             self._state = snapshot_to_replay(doc)
+            self._snap_fed = doc.get("fed")
             self.applied_seq = int(doc.get("seq", 0))
             self._have_snapshot = True
         tail = WriteAheadLog.replay(self.wal_path,
@@ -196,6 +201,7 @@ class HaFollower(threading.Thread):
         with self.server._lock:
             restore_snapshot(self.scheduler, doc)
         self._state = snapshot_to_replay(doc)
+        self._snap_fed = doc.get("fed")
         self.applied_seq = int(doc.get("seq", 0))
         self._have_snapshot = True
         self._mirror_all()
@@ -292,10 +298,26 @@ class HaFollower(threading.Thread):
                         if node is not None and not node.alive:
                             node.alive = True
                             node.last_ping = now
+            # migration history first: drop committed handoffs' jobs,
+            # rebuild imported node meta, re-seal in-flight partitions
+            fed = getattr(s, "fed", None)
+            if fed is not None:
+                fed.prepare_recovery(self.wal_path, self._state,
+                                     snap_fed=self._snap_fed)
             s.recover(self._state, now=now)
             s.rebuild_device_state()
             s.fencing_epoch = epoch
             s.wal = WriteAheadLog(self.wal_path)
+            if fed is not None:
+                fed.recover(now)
+                unresolved = fed.recover_migrations(now)
+                if unresolved:
+                    log.warning(
+                        "%d unresolved migration(s) after promotion "
+                        "[%s] — partitions stay sealed until the "
+                        "destination's has_import answer settles them",
+                        len(unresolved),
+                        ", ".join(r["mid"] for r in unresolved))
         self.server.promote_to_leader(epoch)
         self.promoted.set()
         _ha.FAILOVERS.inc()

@@ -329,22 +329,30 @@ class CtldClient:
                                    fencing_epoch=fencing_epoch),
             pb.OkReply)
 
-    def fetch_usage(self) -> pb.FetchUsageReply:
-        """This shard's usage-gossip summary (cluster-wide accounting)."""
-        return self._call("FetchUsage", pb.FetchUsageRequest(),
+    def fetch_usage(self, shard: str = "") -> pb.FetchUsageReply:
+        """This shard's usage-gossip summary (cluster-wide
+        accounting).  ``shard`` names the PULLING shard — serving the
+        fetch is confirmed delivery to it, which is what releases the
+        server's publish-slack throttle; leave it empty for a CLI
+        query that should ack nobody."""
+        return self._call("FetchUsage",
+                          pb.FetchUsageRequest(shard=shard),
                           pb.FetchUsageReply)
 
     def migrate_partition(self, partition: str, dest_shard: str,
-                          phase: str = "",
-                          payload: str = "") -> pb.MigratePartitionReply:
+                          phase: str = "", payload: str = "",
+                          mid: str = "") -> pb.MigratePartitionReply:
         """Live partition migration: ``phase=""`` drives the whole
         handoff (dial the source shard), ``phase="import"`` ships an
-        exported payload to the destination (shard-to-shard)."""
+        exported payload to the destination (shard-to-shard), and
+        ``phase="query"`` asks the destination whether it durably
+        adopted handoff ``mid`` (the source's resolution path)."""
         return self._call(
             "MigratePartition",
             pb.MigratePartitionRequest(partition=partition,
                                        dest_shard=dest_shard,
-                                       phase=phase, payload=payload),
+                                       phase=phase, payload=payload,
+                                       mid=mid),
             pb.MigratePartitionReply)
 
 

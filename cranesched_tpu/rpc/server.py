@@ -256,6 +256,25 @@ class CtldServer:
             self._fwd_clients[address] = cli
         return cli
 
+    def _query_dest_import(self, address: str, mid: str,
+                           attempts: int = 3
+                           ) -> tuple[bool, int] | None:
+        """Ask the dest whether it durably adopted handoff ``mid``
+        (``phase="query"`` -> has_import).  Returns (adopted, jobs) on
+        an answer, None when the dest stays unreachable — the ONLY
+        outcome that may leave the begin unresolved; never guess."""
+        for i in range(max(attempts, 1)):
+            try:
+                r = self._fed_client(address).migrate_partition(
+                    "", "", phase="query", mid=mid)
+                if r.ok:
+                    return bool(r.adopted), int(r.jobs_moved)
+            except Exception:
+                pass
+            if i + 1 < attempts:
+                time.sleep(0.2)
+        return None
+
     def _forward_submit(self, spec_pb, partition: str, owner: str,
                         address: str, already_forwarded: bool):
         """One-hop forward of a misrouted submit to the owning shard.
@@ -1220,7 +1239,11 @@ class CtldServer:
         """This shard's per-user/per-account usage summary, stamped
         with its WAL watermark (``durable_seq``).  Peers poll this and
         feed the payload to their own UsageBook.ingest — the gossip
-        transport for cluster-wide MaxJobs / fair-share."""
+        transport for cluster-wide MaxJobs / fair-share.  The request
+        names the PULLING shard: serving it is confirmed delivery to
+        that peer, and only the slowest peer's confirmation releases
+        the publish-slack throttle (an anonymous pull — the CLI —
+        acks nobody)."""
         import json as _json
         self._require_authenticated(self._ident(context), context)
         book = self.scheduler.global_usage
@@ -1228,7 +1251,7 @@ class CtldServer:
             return pb.FetchUsageReply(ok=False, shard=self.shard_name,
                                       error="no global accounting")
         with self._lock:
-            doc = book.publish(self._now())
+            doc = book.publish(self._now(), peer=request.shard or "")
             seq = self._durable_seq()
         return pb.FetchUsageReply(ok=True, shard=self.shard_name,
                                   payload=_json.dumps(doc),
@@ -1247,6 +1270,9 @@ class CtldServer:
         * ``phase="import"`` — adopt an exported payload: one WAL group
           creates every job under fresh local ids, then this shard's
           map flips so it starts routing the partition to itself.
+        * ``phase="query"`` — answer :meth:`has_import` for ``mid``:
+          the source's resolution path keys commit-vs-abort on this
+          after an ambiguous import RPC (timeout/drop) or a crash.
         """
         import json as _json
         deny = self._deny_admin(self._ident(context))
@@ -1257,6 +1283,13 @@ class CtldServer:
             return pb.MigratePartitionReply(
                 ok=False, error="not a federation shard")
         now = self._now()
+        if request.phase == "query":
+            with self._lock:
+                adopted = fed.has_import(request.mid)
+                jobs = len(fed.imports.get(str(request.mid)) or [])
+            return pb.MigratePartitionReply(
+                ok=True, mid=request.mid, adopted=adopted,
+                jobs_moved=jobs, map_epoch=self._map_epoch())
         if request.phase == "import":
             try:
                 payload = _json.loads(request.payload)
@@ -1303,18 +1336,59 @@ class CtldServer:
                 payload = fed.export_partition(mid, partition)
             except ValueError as exc:
                 return pb.MigratePartitionReply(ok=False, error=str(exc))
+        adopted = None
+        jobs_moved = 0
+        err = ""
         try:
             dreply = self._fed_client(dspec.address).migrate_partition(
                 partition, dest, phase="import",
-                payload=_json.dumps(payload))
-            if not dreply.ok:
-                raise RuntimeError(dreply.error)
+                payload=_json.dumps(payload), mid=mid)
+            if dreply.ok:
+                adopted = True
+                jobs_moved = int(dreply.jobs_moved)
+            else:
+                # a structured refusal: the dest's two-phase import
+                # validates+mallocs everything BEFORE its first WAL
+                # write, so "not ok" genuinely means nothing adopted
+                adopted = False
+                err = dreply.error
         except Exception as exc:
+            # the RPC died in flight — AMBIGUOUS.  The dest may have
+            # durably imported (and flipped its map) before the
+            # channel dropped; a blind abort here would leave BOTH
+            # shards owning the jobs.  Ask the dest what it holds.
+            err = str(exc)
+            verdict = self._query_dest_import(dspec.address, mid)
+            if verdict is not None:
+                adopted, jobs_moved = verdict
+        if adopted is False:
             with self._lock:
                 fed.abort_migration(mid, partition, now)
             return pb.MigratePartitionReply(
                 ok=False, mid=mid,
-                error=f"dest import failed (aborted): {exc}")
+                error=f"dest import failed (aborted): {err}")
+        if adopted is None:
+            # dest unreachable AND adoption unknown: the ONLY safe
+            # move is none.  The partition stays sealed (no local
+            # admits, no duplicate execution either way) and the
+            # resolver loop settles the begin once the dest answers.
+            with self._lock:
+                if not any(r.get("mid") == mid
+                           for r in fed.unresolved_migrations):
+                    fed.unresolved_migrations.append({
+                        "mid": mid, "partition": partition,
+                        "dest": dest,
+                        "job_ids": [e["job"]["job_id"]
+                                    for e in payload.get("jobs", [])]})
+                self.scheduler.events.emit(
+                    "fed_migrate_unresolved", "warning", time=now,
+                    detail=f"mid={mid} part={partition} dest={dest} "
+                           "(import RPC died; partition sealed "
+                           "pending resolution)")
+            return pb.MigratePartitionReply(
+                ok=False, mid=mid,
+                error=f"dest unreachable after import RPC ({err}); "
+                      "partition stays sealed pending resolution")
         # the dest holds the jobs durably: flip BEFORE commit, so a
         # crash here still routes the partition to the shard that has
         # the jobs; recovery resolves the bare begin against the dest
@@ -1325,10 +1399,10 @@ class CtldServer:
         self.scheduler.events.emit(
             "fed_migrate", "info", time=now,
             detail=f"partition={partition} -> shard={dest} "
-                   f"jobs={dreply.jobs_moved} "
+                   f"jobs={jobs_moved} "
                    f"epoch={self.shard_map.epoch}")
         return pb.MigratePartitionReply(
-            ok=True, mid=mid, jobs_moved=dreply.jobs_moved,
+            ok=True, mid=mid, jobs_moved=jobs_moved,
             map_epoch=self.shard_map.epoch)
 
     def CaptureProfile(self, request, context):
@@ -1546,31 +1620,38 @@ class CtldServer:
             self._usage_thread = threading.Thread(
                 target=self._usage_gossip_loop, daemon=True)
             self._usage_thread.start()
+        if (self.shard_map is not None
+                and self.scheduler.fed is not None):
+            self._resolve_thread = threading.Thread(
+                target=self._fed_resolve_loop, daemon=True)
+            self._resolve_thread.start()
         return port
 
     def _usage_gossip_loop(self) -> None:
-        """Cluster-wide accounting pump (fed/usage.py): publish the
-        local UsageBook on a fixed cadence — the publish IS the
-        throttle release, a shard may run at most ``publish_slack``
-        admissions ahead of its last summary — and pull every peer's
-        latest via FetchUsage, ingesting under the lock.  A peer
-        outage only ages that peer's summary (the conservative
-        admission gate is built for exactly that); it never blocks
-        this loop or the cycle thread."""
+        """Cluster-wide accounting pump (fed/usage.py): pull every
+        peer's latest summary via FetchUsage and ingest it under the
+        lock.  The request carries OUR shard name — serving it is that
+        peer's confirmed delivery to us, and symmetrically our
+        FetchUsage handler marks our counters delivered per pulling
+        peer.  Only the SLOWEST peer's confirmation releases the
+        publish-slack throttle (UsageBook.unconfirmed), so a peer that
+        cannot fetch for several intervals tightens our own admissions
+        instead of letting global limits overshoot.  A peer outage
+        only ages that peer's summary and withholds its acks; it never
+        blocks this loop or the cycle thread."""
         import json as _json
         interval = max(self.cycle_interval, 0.5)
         while not self._stop.wait(interval):
             if self.ha_role != "leader":
                 continue
             book = self.scheduler.global_usage
-            with self._lock:
-                book.publish(self._now())
             for name, spec in self.shard_map.shards.items():
                 if name == self.shard_name or not spec.address:
                     continue
                 try:
                     reply = self._fed_client(
-                        spec.address).fetch_usage()
+                        spec.address).fetch_usage(
+                            shard=self.shard_name)
                     doc = _json.loads(reply.payload) if reply.ok \
                         else None
                 except Exception:
@@ -1578,6 +1659,65 @@ class CtldServer:
                 if doc:
                     with self._lock:
                         book.ingest(doc, self._now())
+
+    def _fed_resolve_loop(self) -> None:
+        """Background settlement of unresolved migration begins (a
+        crash or a dropped import RPC left a durable begin with no
+        commit/abort).  Each pass asks every begin's dest for its
+        has_import answer: adopted -> flip the map and commit; not
+        adopted -> abort and re-open.  Unreachable dests just stay
+        queued — the partition remains sealed, which is safe on both
+        sides."""
+        interval = max(self.cycle_interval * 5.0, 2.0)
+        while not self._stop.wait(interval):
+            if self.ha_role != "leader":
+                continue
+            try:
+                self._resolve_migrations_once()
+            except Exception:
+                pass  # never kill the loop; next tick retries
+
+    def _resolve_migrations_once(self) -> int:
+        """One resolution pass; returns how many begins settled."""
+        fed = self.scheduler.fed
+        if fed is None or self.shard_map is None:
+            return 0
+        with self._lock:
+            pending = [dict(r) for r in fed.unresolved_migrations]
+        settled = 0
+        for rec in pending:
+            mid = str(rec.get("mid", ""))
+            partition = str(rec.get("partition", ""))
+            dest = str(rec.get("dest", ""))
+            spec = self.shard_map.spec(dest) if dest else None
+            if spec is None or not spec.address:
+                continue
+            verdict = self._query_dest_import(spec.address, mid,
+                                              attempts=1)
+            if verdict is None:
+                continue  # still unreachable; stay sealed
+            adopted, _jobs = verdict
+            now = self._now()
+            with self._lock:
+                if not any(r.get("mid") == mid
+                           for r in fed.unresolved_migrations):
+                    continue  # settled concurrently
+                if adopted:
+                    try:
+                        self.shard_map = \
+                            self.shard_map.with_partition_moved(
+                                partition, dest)
+                    except ValueError:
+                        pass  # map already routes it to the dest
+                    fed.commit_migration(mid, partition, now)
+                else:
+                    fed.abort_migration(mid, partition, now)
+                self.scheduler.events.emit(
+                    "fed_migrate_resolved", "info", time=now,
+                    detail=f"mid={mid} part={partition} -> "
+                           + ("commit" if adopted else "abort"))
+            settled += 1
+        return settled
 
     def _cycle_loop(self) -> None:
         """The 1 Hz ScheduleThread_ analog (JobScheduler.cpp:1321,1981).

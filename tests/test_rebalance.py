@@ -223,8 +223,12 @@ def test_source_sigkill_mid_handoff_resolves_to_commit(tmp_path):
     assert audit["still_live"] == []
 
 
-def test_dest_dead_at_import_aborts_and_reopens(tmp_path):
-    """If the destination never adopts, the migration aborts durably
+def test_dest_dead_at_import_stays_sealed_then_resolves(tmp_path):
+    """A dest that dies mid-import is AMBIGUOUS — it may have durably
+    adopted before the call failed, so a blind abort could double
+    every job.  The source keeps the partition sealed (safe on both
+    sides), queues the begin, and resolve() settles it once the dest
+    answers: here the import never landed, so the resolution is abort
     and the partition re-opens in place — jobs drain on the source."""
     fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 3}},
                           wal_dir=str(tmp_path))
@@ -237,11 +241,22 @@ def test_dest_dead_at_import_aborts_and_reopens(tmp_path):
 
     with pytest.raises(RuntimeError):
         fc.migrate("batch", "west", on_exported=kill_dest)
-    # no flip happened; the seal was annulled
+    # no flip happened, and the partition stays SEALED pending the
+    # dest's has_import answer — never unsealed on a guess
     assert fc.shard_map.epoch == 0
     assert fc.shard_map.shard_for_partition("batch") == "east"
-    assert "batch" not in fc.shards["east"].scheduler.sealed_partitions
+    assert "batch" in fc.shards["east"].scheduler.sealed_partitions
+    assert len(fc.coordinator.pending_resolution) == 1
+    # while the dest is still down, resolution stays pending
+    settled = fc.resolve_migrations("east")
+    assert [r["resolution"] for r in settled] == ["pending"]
+    assert "batch" in fc.shards["east"].scheduler.sealed_partitions
+    # dest recovers with no import record -> abort, re-open in place
     fc.recover("west")
+    settled = fc.resolve_migrations("east")
+    assert [r["resolution"] for r in settled] == ["abort"]
+    assert "batch" not in fc.shards["east"].scheduler.sealed_partitions
+    assert fc.coordinator.pending_resolution == []
     fc.run_until_drained(max_cycles=2000)
     audit = fc.ledger_by_name(names)
     assert audit["lost"] == [] and audit["doubled"] == []
@@ -550,3 +565,116 @@ def test_migrate_partition_rpc_end_to_end():
                 c.close()
         for s in servers.values():
             s.stop()
+
+
+# ---------------------------------------------------------------------------
+# review hardening: delivery-confirmed throttle, slack clamping, and
+# ambiguity-safe migration resolution
+# ---------------------------------------------------------------------------
+
+def test_publish_throttle_releases_only_on_slowest_peer_ack():
+    """Building a summary document is NOT delivery: the throttle must
+    hold until the SLOWEST peer confirms pulling — otherwise a peer
+    that cannot fetch for several intervals lets this shard outrun
+    what the federation knows and the global limits overshoot."""
+    limits = GlobalLimits(max_submit_jobs_per_user=100)
+    book = UsageBook("a", limits, n_shards=3, publish_slack=2,
+                     peers=("b", "c"))
+    book.note_submit("u", "")
+    book.note_submit("u", "")
+    assert "overdue" in book.check_submit("u", "")
+    # an anonymous publish (the old loop built and DISCARDED a doc
+    # every interval) releases nothing
+    book.publish(0.0)
+    assert "overdue" in book.check_submit("u", "")
+    # one peer pulling is not enough — the slowest peer still lags
+    book.publish(1.0, peer="b")
+    assert "overdue" in book.check_submit("u", "")
+    # ...only when EVERY peer has confirmed does admission resume
+    book.publish(2.0, peer="c")
+    assert book.check_submit("u", "") == ""
+
+
+def test_effective_publish_slack_clamps_unsatisfiable_config():
+    """MaxJobsPerUser=10 with 3 shards and the default slack 8 makes
+    the gate admit only while known+1 <= 10-16: every submit denied
+    forever on an idle cluster.  Startup must clamp."""
+    from cranesched_tpu.fed.usage import effective_publish_slack
+    limits = GlobalLimits(max_jobs_per_user=10)
+    assert effective_publish_slack(limits, 3, 8) == (4, 8)
+    # a satisfiable config passes through untouched
+    assert effective_publish_slack(limits, 3, 4) == (4, 4)
+    # no finite limit / single shard / zero slack: nothing to clamp
+    assert effective_publish_slack(GlobalLimits(), 3, 8) == (8, 8)
+    assert effective_publish_slack(limits, 1, 8) == (8, 8)
+    assert effective_publish_slack(limits, 3, 0) == (0, 0)
+
+
+def test_import_call_death_after_durable_adopt_commits_not_doubles(
+        tmp_path):
+    """The import CALL failing does not mean the import failed: here
+    the dest durably adopts and THEN the call dies (the timeout /
+    dropped-reply analog).  A blind abort would unseal the source
+    while the dest runs its copies — every job doubled.  The
+    coordinator must ask has_import and commit."""
+    fc = FederatedCluster({"east": {"batch": 3}, "west": {"gpu": 3}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc, n=12)
+    for _ in range(2):
+        fc.tick()
+    handle = fc.handles["west"]
+    real_import = handle.import_
+
+    def import_then_die(payload, now):
+        real_import(payload, now)
+        raise OSError("connection reset mid-reply")
+
+    handle.import_ = import_then_die
+    result = fc.migrate("batch", "west")
+    handle.import_ = real_import
+    assert result["committed"] is True
+    assert fc.shard_map.shard_for_partition("batch") == "west"
+    # exactly one owner: the source dropped its copies
+    east = fc.shards["east"].scheduler
+    assert not any(j.spec.partition == "batch"
+                   for j in list(east.pending.values())
+                   + list(east.running.values()))
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names)
+    assert audit["lost"] == [] and audit["doubled"] == []
+    assert audit["still_live"] == []
+
+
+def test_dest_restart_after_snapshot_prune_keeps_imported_partition(
+        tmp_path):
+    """Segment pruning deletes fed_migrate_* records once a snapshot
+    covers them — the snapshot's ``fed`` document must stand in, or a
+    dest restart loses the imported partition's node meta and its
+    has_import answer (and the source's begin would then resolve to a
+    spurious abort)."""
+    from cranesched_tpu.ha.snapshot import (
+        SnapshotStore,
+        capture_snapshot,
+    )
+    fc = FederatedCluster({"east": {"batch": 2}, "west": {"gpu": 2}},
+                          wal_dir=str(tmp_path))
+    names = _storm(fc, n=8)
+    fc.tick()
+    result = fc.migrate("batch", "west")
+    west = fc.shards["west"]
+    # the leader snapshot cadence: capture, rotate, persist, prune —
+    # the import record's segment is gone afterwards
+    doc = capture_snapshot(west.scheduler)
+    west.scheduler.wal.rotate()
+    SnapshotStore(west.wal_path).save(doc)
+    west.scheduler.wal.prune_segments(doc["seq"])
+    assert WriteAheadLog.replay_migrations(west.wal_path) == {}
+    fc.kill("west")
+    fc.recover("west")
+    west = fc.shards["west"]
+    assert "batch" in west.meta.partitions
+    assert west.fed.has_import(result["mid"])
+    fc.run_until_drained(max_cycles=2000)
+    audit = fc.ledger_by_name(names)
+    assert audit["lost"] == [] and audit["doubled"] == []
+    assert audit["still_live"] == []

@@ -15,6 +15,10 @@ import time
 import grpc
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="cluster-PKI tests need the cryptography package")
+
 from cranesched_tpu.craned.daemon import CranedDaemon, CranedState
 from cranesched_tpu.craned.sim import SimCluster
 from cranesched_tpu.ctld import (

@@ -327,6 +327,19 @@ def mutate(fd: descriptor_pb2.FileDescriptorProto) -> int:
         ("error", 5, F.TYPE_STRING),
     ])
 
+    # migration-handoff hardening: the usage gossip acks per pulling
+    # peer (the publish throttle releases only on confirmed delivery),
+    # and an import-RPC failure is resolved by QUERYING the dest
+    # (phase="query" answers has_import by mid) instead of blindly
+    # aborting — a timeout after a durable import must not leave both
+    # shards owning the jobs
+    n += _add_field(_msg(fd, "FetchUsageRequest"), "shard", 1,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "MigratePartitionRequest"), "mid", 5,
+                    F.TYPE_STRING)
+    n += _add_field(_msg(fd, "MigratePartitionReply"), "adopted", 6,
+                    F.TYPE_BOOL)
+
     # gang rendezvous epochs (ISSUE 17): the coordinator tags its
     # incarnation; a member still retrying against a restarted
     # coordinator gets a typed stale-epoch rejection instead of
